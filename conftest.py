@@ -1,0 +1,20 @@
+"""
+Repo-root pytest bootstrap: force the XLA-CPU backend with 8 virtual devices
+(the "fake TPU" test backend; SURVEY.md §4) before any jax computation runs.
+
+Note: the environment's sitecustomize imports jax at interpreter boot with
+JAX_PLATFORMS=axon latched, so the platform override must go through
+jax.config, not environment variables.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

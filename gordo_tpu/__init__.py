@@ -1,0 +1,38 @@
+"""
+gordo-tpu: a TPU-native framework for building, training, and serving
+thousands of timeseries anomaly-detection models from a single YAML config.
+
+Capability parity target: Equinor "gordo" (see SURVEY.md). Architecture is
+JAX/XLA-first: the model zoo is Flax, per-machine training is batched with
+``vmap`` and sharded across a TPU mesh with ``jit``/``shard_map``, and the
+server evaluates anomaly scores with XLA-compiled batched inference.
+"""
+
+__version__ = "0.1.0"
+
+
+def _parse_version(version: str):
+    """
+    Parse a semver-ish version string into (major, minor, is_unstable).
+
+    Reference parity: gordo/__init__.py:15-46 (_parse_version).
+
+    Examples
+    --------
+    >>> _parse_version("1.2.3")
+    (1, 2, False)
+    >>> _parse_version("0.55.0.dev3+eaa2df2b")
+    (0, 55, True)
+    """
+    parts = version.split(".")
+    try:
+        major, minor = int(parts[0]), int(parts[1])
+    except (ValueError, IndexError):
+        return 0, 0, True
+    unstable = len(parts) > 3 or any(
+        not p.isdigit() for p in parts[:3] if p
+    ) or (len(parts) > 2 and not parts[2].isdigit())
+    return major, minor, unstable
+
+
+MAJOR_VERSION, MINOR_VERSION, IS_UNSTABLE_VERSION = _parse_version(__version__)

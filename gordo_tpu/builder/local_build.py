@@ -1,0 +1,31 @@
+"""
+In-process dev/test loop: config string → trained (model, machine) pairs.
+
+Reference parity: gordo/builder/local_build.py:14-73. This is also the
+entry the test-suite uses to produce real artifacts quickly, and the
+fallback serial path of the batched trainer.
+"""
+
+from typing import Iterable, Optional, Tuple, Union
+
+import yaml
+
+from gordo_tpu.builder.build_model import ModelBuilder
+from gordo_tpu.machine import Machine
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+
+def local_build(
+    config_str: str,
+    project_name: str = "local-build",
+    enable_mlflow: bool = False,
+) -> Iterable[Tuple[Union[object, None], Machine]]:
+    """
+    Build model(s) from a (possibly multi-machine) config string, yielding
+    one (model, machine) pair per machine.
+    """
+    config = yaml.safe_load(config_str)
+    norm_config = NormalizedConfig(config, project_name=project_name)
+    for machine in norm_config.machines:
+        model, machine_out = ModelBuilder(machine).build()
+        yield model, machine_out

@@ -1,0 +1,28 @@
+"""
+Data layer: sensor tags, data providers, and timeseries datasets.
+
+This re-provides the surface of the external ``gordo-dataset`` package that the
+reference framework depends on (SURVEY.md L0): ``GordoBaseDataset.from_dict``,
+``get_data() -> (X, y)``, ``get_metadata()``, ``SensorTag``,
+``RandomDataProvider`` / ``RandomDataset`` for tests.
+
+The implementation is brand-new and column-oriented: tag series are joined on a
+resampled time grid and materialised as contiguous float32 arrays so they can be
+fed straight to device without further copies.
+"""
+
+from .sensor_tag import SensorTag, normalize_sensor_tag, normalize_sensor_tags
+from .data_provider import GordoBaseDataProvider, RandomDataProvider
+from .datasets import GordoBaseDataset, TimeSeriesDataset, RandomDataset, InsufficientDataError
+
+__all__ = [
+    "SensorTag",
+    "normalize_sensor_tag",
+    "normalize_sensor_tags",
+    "GordoBaseDataProvider",
+    "RandomDataProvider",
+    "GordoBaseDataset",
+    "TimeSeriesDataset",
+    "RandomDataset",
+    "InsufficientDataError",
+]

@@ -1,0 +1,159 @@
+"""
+Data providers: pluggable sources of per-tag timeseries.
+
+Re-provides the provider abstraction the reference gets from gordo-dataset
+(SURVEY.md L0; used at gordo/builder/build_model.py:185-190 via
+``dataset.get_data()`` and throughout the tests as ``RandomDataProvider``,
+tests/conftest.py:171-172).
+
+Providers yield one ``pandas.Series`` per tag. ``RandomDataProvider`` is the
+deterministic fake backend used by the test-suite and benchmarks: values are
+seeded per tag name so any process regenerates identical data without I/O.
+"""
+
+import abc
+import zlib
+from datetime import datetime
+from typing import Iterable, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from .sensor_tag import SensorTag
+
+_PROVIDER_REGISTRY = {}
+
+
+def register_data_provider(cls):
+    """Class decorator: register a provider under its class name for from_dict."""
+    _PROVIDER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class GordoBaseDataProvider(abc.ABC):
+    @abc.abstractmethod
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        """Yield one series per tag covering [train_start_date, train_end_date)."""
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return True
+
+    @classmethod
+    def from_dict(cls, config: dict) -> "GordoBaseDataProvider":
+        config = dict(config)
+        kind = config.pop("type", "RandomDataProvider")
+        # accept dotted paths for compatibility; resolve on last component
+        kind = kind.rsplit(".", 1)[-1]
+        if kind not in _PROVIDER_REGISTRY:
+            raise ValueError(
+                f"Unknown data provider type {kind!r}; "
+                f"available: {sorted(_PROVIDER_REGISTRY)}"
+            )
+        return _PROVIDER_REGISTRY[kind](**config)
+
+    def to_dict(self) -> dict:
+        out = dict(getattr(self, "_init_kwargs", {}))
+        out["type"] = type(self).__name__
+        return out
+
+
+@register_data_provider
+class RandomDataProvider(GordoBaseDataProvider):
+    """
+    Deterministic synthetic sensor data.
+
+    Each tag gets a smooth sine-mixture signal plus noise on a fixed-resolution
+    grid; the RNG seed derives from the tag name, so data is identical across
+    processes and runs (parity with gordo-dataset's RandomDataProvider used in
+    reference tests/conftest.py:150-214).
+    """
+
+    def __init__(
+        self,
+        min_size: int = 100,
+        max_size: int = 300,
+        resolution: str = "10min",
+        seed: int = 0,
+        **kwargs,
+    ):
+        self.min_size = min_size
+        self.max_size = max_size
+        self.resolution = resolution
+        self.seed = seed
+        self._init_kwargs = dict(
+            min_size=min_size, max_size=max_size, resolution=resolution, seed=seed
+        )
+
+    def _tag_seed(self, tag: SensorTag) -> int:
+        return (zlib.crc32(tag.name.encode()) ^ self.seed) & 0x7FFFFFFF
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        index = pd.date_range(
+            start=train_start_date,
+            end=train_end_date,
+            freq=self.resolution,
+            inclusive="left",
+        )
+        n = len(index)
+        if n == 0:
+            return
+        t = np.arange(n, dtype=np.float64)
+        for tag in tag_list:
+            rng = np.random.RandomState(self._tag_seed(tag))
+            # sine mixture + random walk noise: looks like slow sensor drift
+            freqs = rng.uniform(0.001, 0.05, size=3)
+            amps = rng.uniform(0.5, 2.0, size=3)
+            phases = rng.uniform(0, 2 * np.pi, size=3)
+            base = sum(a * np.sin(2 * np.pi * f * t + p) for f, a, p in zip(freqs, amps, phases))
+            noise = rng.normal(0, 0.1, size=n)
+            offset = rng.uniform(-10, 10)
+            values = base + noise + offset
+            yield pd.Series(values, index=index, name=tag.name)
+
+
+@register_data_provider
+class InfluxDataProvider(GordoBaseDataProvider):
+    """
+    Placeholder for the InfluxDB-backed provider. The interface is kept so
+    configs referencing it parse; actual network I/O is out of scope in this
+    environment (reference analog lives in gordo-dataset).
+    """
+
+    def __init__(self, measurement: str = "sensors", value_name: str = "Value", **kwargs):
+        self.measurement = measurement
+        self.value_name = value_name
+        self._init_kwargs = dict(measurement=measurement, value_name=value_name, **kwargs)
+
+    def load_series(self, train_start_date, train_end_date, tag_list, dry_run=False):
+        raise NotImplementedError(
+            "InfluxDataProvider requires a live InfluxDB; use RandomDataProvider "
+            "or a custom provider in this environment."
+        )
+
+
+@register_data_provider
+class DataLakeProvider(GordoBaseDataProvider):
+    """Placeholder for the Azure Data Lake provider (interface parity only)."""
+
+    def __init__(self, storename: Optional[str] = None, interactive: bool = False, **kwargs):
+        self.storename = storename
+        self.interactive = interactive
+        self._init_kwargs = dict(storename=storename, interactive=interactive, **kwargs)
+
+    def load_series(self, train_start_date, train_end_date, tag_list, dry_run=False):
+        raise NotImplementedError(
+            "DataLakeProvider requires Azure credentials; use RandomDataProvider "
+            "or a custom provider in this environment."
+        )

@@ -1,0 +1,243 @@
+"""
+Datasets: config-dict-driven assembly of (X, y) training frames.
+
+Re-provides the used surface of gordo-dataset's ``GordoBaseDataset`` /
+``TimeSeriesDataset`` / ``RandomDataset`` (reference usage:
+gordo/machine/machine.py:109 ``GordoBaseDataset.from_dict``;
+gordo/builder/build_model.py:185-190 ``get_data()``; metadata flows into
+DatasetBuildMetadata).
+
+TPU-first notes: ``get_data`` returns pandas frames (the CPU-side contract the
+rest of the stack expects) but internally builds one contiguous float32 matrix;
+``get_arrays`` exposes that matrix directly for the batched multi-machine
+trainer so no per-machine pandas work happens on the hot path.
+"""
+
+import abc
+import logging
+import time
+from datetime import datetime
+from typing import Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from .data_provider import GordoBaseDataProvider, RandomDataProvider
+from .sensor_tag import SensorTag, normalize_sensor_tags
+
+logger = logging.getLogger(__name__)
+
+_DATASET_REGISTRY: Dict[str, type] = {}
+
+
+class InsufficientDataError(ValueError):
+    """Raised when fewer rows survive joining/filtering than the threshold."""
+
+
+def register_dataset(cls):
+    _DATASET_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class GordoBaseDataset(abc.ABC):
+    @abc.abstractmethod
+    def get_data(self) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        """Return (X, y) frames indexed by timestamp."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> dict:
+        """Return dataset build metadata (row counts, durations, tag list...)."""
+
+    @classmethod
+    def from_dict(cls, config: dict) -> "GordoBaseDataset":
+        config = dict(config)
+        kind = config.pop("type", "TimeSeriesDataset")
+        kind = kind.rsplit(".", 1)[-1]
+        if kind not in _DATASET_REGISTRY:
+            raise ValueError(
+                f"Unknown dataset type {kind!r}; available: {sorted(_DATASET_REGISTRY)}"
+            )
+        return _DATASET_REGISTRY[kind](**config)
+
+    def to_dict(self) -> dict:
+        out = dict(getattr(self, "_init_kwargs", {}))
+        out["type"] = type(self).__name__
+        return out
+
+
+def _parse_dt(value: Union[str, datetime]) -> pd.Timestamp:
+    ts = pd.Timestamp(value)
+    if ts.tzinfo is None:
+        raise ValueError(f"Datetime {value!r} must be timezone-aware")
+    return ts
+
+
+@register_dataset
+class TimeSeriesDataset(GordoBaseDataset):
+    """
+    Join per-tag series onto a resampled grid and emit (X, y).
+
+    Parameters mirror the reference's dataset config surface: ``tags``,
+    ``target_tag_list``, ``train_start_date``/``train_end_date``,
+    ``data_provider``, ``resolution``, ``row_filter``, ``aggregation_methods``,
+    ``n_samples_threshold``, ``asset``.
+    """
+
+    def __init__(
+        self,
+        train_start_date: Union[str, datetime],
+        train_end_date: Union[str, datetime],
+        tag_list: Optional[List] = None,
+        tags: Optional[List] = None,
+        target_tag_list: Optional[List] = None,
+        data_provider: Optional[Union[dict, GordoBaseDataProvider]] = None,
+        resolution: str = "10min",
+        row_filter: str = "",
+        aggregation_methods: Union[str, List[str]] = "mean",
+        n_samples_threshold: int = 0,
+        asset: Optional[str] = None,
+        interpolation_method: str = "linear_interpolation",
+        interpolation_limit: str = "8h",
+        **kwargs,
+    ):
+        tags = tags if tags is not None else tag_list
+        if not tags:
+            raise ValueError("TimeSeriesDataset requires a non-empty 'tags' list")
+        self.train_start_date = _parse_dt(train_start_date)
+        self.train_end_date = _parse_dt(train_end_date)
+        if self.train_start_date >= self.train_end_date:
+            raise ValueError(
+                f"train_start_date ({self.train_start_date}) must be before "
+                f"train_end_date ({self.train_end_date})"
+            )
+        self.asset = asset
+        self.tag_list = normalize_sensor_tags(tags, asset=asset)
+        self.target_tag_list = (
+            normalize_sensor_tags(target_tag_list, asset=asset)
+            if target_tag_list
+            else list(self.tag_list)
+        )
+        if isinstance(data_provider, GordoBaseDataProvider):
+            self.data_provider = data_provider
+        elif isinstance(data_provider, dict):
+            self.data_provider = GordoBaseDataProvider.from_dict(data_provider)
+        elif data_provider is None:
+            self.data_provider = RandomDataProvider()
+        else:
+            raise ValueError(f"Invalid data_provider: {data_provider!r}")
+        self.resolution = resolution
+        self.row_filter = row_filter
+        self.aggregation_methods = aggregation_methods
+        self.n_samples_threshold = n_samples_threshold
+        self.interpolation_method = interpolation_method
+        self.interpolation_limit = interpolation_limit
+        self._metadata: dict = {}
+
+        self._init_kwargs = dict(
+            train_start_date=self.train_start_date.isoformat(),
+            train_end_date=self.train_end_date.isoformat(),
+            tags=[t.to_json() for t in self.tag_list],
+            target_tag_list=[t.to_json() for t in self.target_tag_list],
+            data_provider=self.data_provider.to_dict(),
+            resolution=resolution,
+            row_filter=row_filter,
+            aggregation_methods=aggregation_methods,
+            n_samples_threshold=n_samples_threshold,
+            asset=asset,
+            interpolation_method=interpolation_method,
+            interpolation_limit=interpolation_limit,
+        )
+
+    # ------------------------------------------------------------------ data
+    def _join_series(self) -> pd.DataFrame:
+        t0 = time.monotonic()
+        all_tags = list(dict.fromkeys(self.tag_list + self.target_tag_list))
+        series_iter = self.data_provider.load_series(
+            self.train_start_date.to_pydatetime(),
+            self.train_end_date.to_pydatetime(),
+            all_tags,
+        )
+        frames = {}
+        for tag, series in zip(all_tags, series_iter):
+            resampled = series.resample(self.resolution).agg(self.aggregation_methods)
+            if isinstance(resampled, pd.DataFrame):
+                # multiple aggregation methods: one column per (tag, method)
+                for method in resampled.columns:
+                    frames[f"{tag.name}_{method}"] = resampled[method]
+            else:
+                frames[tag.name] = resampled
+        df = pd.DataFrame(frames)
+        if self.interpolation_method == "linear_interpolation":
+            limit = max(
+                int(pd.Timedelta(self.interpolation_limit) / pd.Timedelta(self.resolution)),
+                1,
+            )
+            df = df.interpolate(method="linear", limit=limit)
+        df = df.dropna()
+        if self.row_filter:
+            df = df.query(self.row_filter)
+        self._metadata["query_duration_sec"] = time.monotonic() - t0
+        return df
+
+    def get_data(self) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        df = self._join_series()
+        if len(df) <= self.n_samples_threshold:
+            raise InsufficientDataError(
+                f"Only {len(df)} rows after joining/filtering; "
+                f"threshold is {self.n_samples_threshold}"
+            )
+        def _cols_for(tags):
+            if isinstance(self.aggregation_methods, (list, tuple)):
+                return [
+                    f"{t.name}_{m}" for t in tags for m in self.aggregation_methods
+                ]
+            return [t.name for t in tags]
+
+        X = df[_cols_for(self.tag_list)]
+        y = df[_cols_for(self.target_tag_list)]
+        self._metadata["dataset_meta"] = {
+            "row_count": int(len(df)),
+            "x_hist": {},
+            "tag_loading_metadata": {
+                "tags": {t.name: t.to_json() for t in self.tag_list},
+            },
+        }
+        return X, y
+
+    def get_arrays(self) -> Tuple[np.ndarray, np.ndarray, pd.DatetimeIndex]:
+        """Device-ready contiguous float32 matrices (X, y, index) — the fast
+        path used by the batched multi-machine trainer."""
+        X, y = self.get_data()
+        return (
+            np.ascontiguousarray(X.to_numpy(dtype=np.float32)),
+            np.ascontiguousarray(y.to_numpy(dtype=np.float32)),
+            X.index,
+        )
+
+    def get_metadata(self) -> dict:
+        meta = {
+            "train_start_date": self.train_start_date.isoformat(),
+            "train_end_date": self.train_end_date.isoformat(),
+            "tag_list": [t.to_json() for t in self.tag_list],
+            "target_tag_list": [t.to_json() for t in self.target_tag_list],
+            "resolution": self.resolution,
+            "row_filter": self.row_filter,
+        }
+        meta.update(self._metadata)
+        return meta
+
+
+@register_dataset
+class RandomDataset(TimeSeriesDataset):
+    """TimeSeriesDataset pinned to the deterministic RandomDataProvider."""
+
+    def __init__(self, train_start_date, train_end_date, tag_list=None, tags=None, **kwargs):
+        kwargs.pop("data_provider", None)
+        super().__init__(
+            train_start_date=train_start_date,
+            train_end_date=train_end_date,
+            tag_list=tag_list,
+            tags=tags,
+            data_provider=RandomDataProvider(),
+            **kwargs,
+        )

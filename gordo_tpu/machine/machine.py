@@ -1,0 +1,164 @@
+"""
+The Machine domain object: one industrial asset = one model to build.
+
+Reference parity: gordo/machine/machine.py:27-224 — same fields
+(name/model/dataset/runtime/evaluation/metadata/project_name), same
+global-config patching semantics in ``from_config`` (globals patch the
+machine's dataset; the machine's runtime/evaluation patch the globals), same
+reporter dispatch and numpy/datetime-safe JSON encoder.
+"""
+
+import json
+import logging
+from datetime import datetime
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+import yaml
+
+from gordo_tpu.dataset import GordoBaseDataset
+from gordo_tpu.machine.metadata import Metadata
+from gordo_tpu.machine.validators import (
+    ValidDataset,
+    ValidMachineRuntime,
+    ValidMetadata,
+    ValidModel,
+    ValidUrlString,
+)
+from gordo_tpu.workflow.helpers import patch_dict
+
+logger = logging.getLogger(__name__)
+
+
+class Machine:
+    """Represents a single machine in a config file."""
+
+    name = ValidUrlString()
+    project_name = ValidUrlString()
+    host = ValidUrlString()
+    model = ValidModel()
+    dataset = ValidDataset()
+    metadata = ValidMetadata()
+    runtime = ValidMachineRuntime()
+    _strict = True
+
+    def __init__(
+        self,
+        name: str,
+        model: dict,
+        dataset: Union[GordoBaseDataset, dict],
+        project_name: str,
+        evaluation: Optional[dict] = None,
+        metadata: Optional[Union[dict, Metadata]] = None,
+        runtime=None,
+    ):
+        if runtime is None:
+            runtime = dict()
+        if evaluation is None:
+            evaluation = dict(cv_mode="full_build")
+        if metadata is None:
+            metadata = dict()
+        self.name = name
+        self.model = model
+        self.dataset = (
+            dataset
+            if isinstance(dataset, GordoBaseDataset)
+            else GordoBaseDataset.from_dict(dataset)
+        )
+        self.runtime = runtime
+        self.evaluation = evaluation
+        self.metadata = (
+            metadata if isinstance(metadata, Metadata) else Metadata.from_dict(metadata)
+        )
+        self.project_name = project_name
+        self.host = f"gordoserver-{self.project_name}-{self.name}"
+
+    @classmethod
+    def from_config(
+        cls,
+        config: Dict[str, Any],
+        project_name: str = "project",
+        config_globals: Optional[dict] = None,
+    ) -> "Machine":
+        """Build a Machine from one YAML config block plus the `globals` block."""
+        if config_globals is None:
+            config_globals = dict()
+
+        name = config["name"]
+        model = config.get("model") or config_globals.get("model")
+
+        local_runtime = config.get("runtime", dict())
+        runtime = patch_dict(config_globals.get("runtime", dict()), local_runtime)
+
+        dataset_config = patch_dict(
+            config.get("dataset", dict()), config_globals.get("dataset", dict())
+        )
+        dataset = GordoBaseDataset.from_dict(dataset_config)
+        evaluation = patch_dict(
+            config_globals.get("evaluation", dict()), config.get("evaluation", dict())
+        )
+
+        metadata = Metadata(
+            user_defined={
+                "global-metadata": config_globals.get("metadata", dict()),
+                "machine-metadata": config.get("metadata", dict()),
+            }
+        )
+        return cls(
+            name,
+            model,
+            dataset,
+            metadata=metadata,
+            runtime=runtime,
+            project_name=project_name,
+            evaluation=evaluation,
+        )
+
+    def __str__(self):
+        return yaml.dump(self.to_dict())
+
+    def __eq__(self, other):
+        return self.to_dict() == other.to_dict()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Machine":
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "dataset": self.dataset.to_dict(),
+            "model": self.model,
+            "metadata": self.metadata.to_dict(),
+            "runtime": self.runtime,
+            "project_name": self.project_name,
+            "evaluation": self.evaluation,
+        }
+
+    def report(self):
+        """
+        Run any reporters declared in the machine's runtime, e.g.::
+
+            runtime:
+              reporters:
+                - gordo_tpu.reporters.postgres.PostgresReporter:
+                    host: my-special-host
+        """
+        from gordo_tpu.reporters.base import BaseReporter
+
+        for reporter in map(BaseReporter.from_dict, self.runtime.get("reporters", [])):
+            logger.debug("Using reporter: %s", reporter)
+            reporter.report(self)
+
+
+class MachineEncoder(json.JSONEncoder):
+    """JSON encoder tolerating datetimes and numpy scalars."""
+
+    def default(self, obj):
+        if isinstance(obj, datetime):
+            return obj.isoformat()
+        elif np.issubdtype(type(obj), np.floating):
+            return float(obj)
+        elif np.issubdtype(type(obj), np.integer):
+            return int(obj)
+        return json.JSONEncoder.default(self, obj)

@@ -1,0 +1,147 @@
+"""
+Descriptor-based validation of Machine fields.
+
+Reference parity: gordo/machine/validators.py:18-322 — k8s DNS-label name
+rules, model definitions validated by an actual ``from_definition`` dry-run,
+timezone-aware datetimes, machine-runtime resource fix-ups.
+"""
+
+import logging
+import re
+from datetime import datetime
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class BaseDescriptor:
+    """Data descriptor validating on __set__."""
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return instance.__dict__.get(self.name)
+
+    def __set__(self, instance, value):
+        raise NotImplementedError("Subclass must implement __set__")
+
+
+class ValidUrlString(BaseDescriptor):
+    """
+    Value must be a valid k8s DNS label: lowercase alphanumerics and dashes,
+    not starting/ending with a dash, <= 63 chars
+    (reference validators.py:271-322).
+    """
+
+    def __set__(self, instance, value):
+        if value is not None and not self.valid_url_string(value):
+            raise ValueError(
+                f"{self.name}: '{value}' is not a valid name: must match "
+                f"[a-z0-9]([-a-z0-9]*[a-z0-9])? and be at most 63 characters"
+            )
+        instance.__dict__[self.name] = value
+
+    @staticmethod
+    def valid_url_string(string: str) -> bool:
+        """
+        >>> ValidUrlString.valid_url_string("valid-name-here")
+        True
+        >>> ValidUrlString.valid_url_string("Not_a-valid-name")
+        False
+        """
+        if len(string) > 63:
+            return False
+        return bool(re.match(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$", string))
+
+
+class ValidModel(BaseDescriptor):
+    """Model definition must round-trip through from_definition (dry-run)."""
+
+    def __set__(self, instance, value):
+        if getattr(instance, "_strict", True):
+            from gordo_tpu.serializer import from_definition
+
+            if not isinstance(value, dict):
+                raise ValueError(f"{self.name} must be a dict definition, got {value!r}")
+            try:
+                from_definition(value)
+            except Exception as exc:
+                raise ValueError(f"Invalid model definition: {exc}") from exc
+        instance.__dict__[self.name] = value
+
+
+class ValidDataset(BaseDescriptor):
+    def __set__(self, instance, value):
+        from gordo_tpu.dataset import GordoBaseDataset
+
+        if not isinstance(value, GordoBaseDataset):
+            raise ValueError(f"{self.name} must be a GordoBaseDataset")
+        instance.__dict__[self.name] = value
+
+
+class ValidMetadata(BaseDescriptor):
+    def __set__(self, instance, value):
+        from gordo_tpu.machine.metadata import Metadata
+
+        if value is not None and not isinstance(value, (dict, Metadata)):
+            raise ValueError(f"{self.name} must be a dict or Metadata instance")
+        instance.__dict__[self.name] = value
+
+
+class ValidDatetime(BaseDescriptor):
+    """Must be a timezone-aware datetime (reference validators.py)."""
+
+    def __set__(self, instance, value):
+        if not isinstance(value, datetime) or value.tzinfo is None:
+            raise ValueError(f"{self.name} must be a timezone-aware datetime")
+        instance.__dict__[self.name] = value
+
+
+def fix_resource_limits(resources: dict) -> dict:
+    """
+    Ensure requests <= limits for cpu/memory in a k8s-style resources dict
+    (reference validators.py:172-231): if both are given and request > limit,
+    the request is lowered to the limit.
+    """
+    resources = dict(resources)
+    for resource_type in ("requests", "limits"):
+        if resource_type in resources and resources[resource_type] is not None:
+            for key, val in resources[resource_type].items():
+                if val is None:
+                    continue
+                try:
+                    resources[resource_type][key] = int(val)
+                except ValueError as e:
+                    raise ValueError(
+                        f"Resource {resource_type}.{key} value {val!r} is not an int"
+                    ) from e
+    requests = resources.get("requests", {}) or {}
+    limits = resources.get("limits", {}) or {}
+    for key in ("memory", "cpu"):
+        request = requests.get(key)
+        limit = limits.get(key)
+        if request is not None and limit is not None and request > limit:
+            logger.warning(
+                "Resource request %s (%s) exceeds limit (%s); lowering request",
+                key, request, limit,
+            )
+            requests[key] = limit
+    return resources
+
+
+class ValidMachineRuntime(BaseDescriptor):
+    """Runtime dict; resource requests/limits are fixed up on set."""
+
+    def __set__(self, instance, value):
+        if not isinstance(value, dict):
+            raise ValueError(f"{self.name} must be a dict")
+        for section in ("builder", "server"):
+            if section in value and isinstance(value[section], dict):
+                if "resources" in value[section]:
+                    value[section]["resources"] = fix_resource_limits(
+                        value[section]["resources"]
+                    )
+        instance.__dict__[self.name] = value

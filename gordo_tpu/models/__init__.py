@@ -1,0 +1,15 @@
+"""
+Model zoo: JAX/XLA-native sklearn-compatible estimators.
+
+Reference parity: gordo/machine/model/ (SURVEY.md L2). The reference wraps
+Keras/TF models in sklearn estimators; here the zoo is pure JAX — model
+architectures are declarative ``ModelSpec`` pytrees, parameters are plain
+pytrees of ``jnp`` arrays, and training is a single XLA program
+(``lax.scan`` over batches inside ``jit``). This keeps every model trivially
+``vmap``-able for the batched multi-machine trainer (gordo_tpu.parallel).
+"""
+
+from . import models  # noqa: F401 — registers factories
+from .base import GordoBase
+
+__all__ = ["GordoBase", "models"]

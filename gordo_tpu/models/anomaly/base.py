@@ -1,0 +1,26 @@
+"""
+Anomaly detector ABC.
+
+Reference parity: gordo/machine/model/anomaly/base.py:11-23.
+"""
+
+import abc
+from datetime import timedelta
+from typing import Optional, Union
+
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator
+
+from gordo_tpu.models.base import GordoBase
+
+
+class AnomalyDetectorBase(BaseEstimator, GordoBase, metaclass=abc.ABCMeta):
+    @abc.abstractmethod
+    def anomaly(
+        self,
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray],
+        frequency: Optional[timedelta] = None,
+    ) -> pd.DataFrame:
+        """Take (X, y) and return a dataframe of anomaly scores."""

@@ -1,0 +1,462 @@
+"""
+Diff-based anomaly detection: wrap a base estimator, score anomalies as the
+(scaled) difference between model output and target, with thresholds learned
+from cross-validation folds.
+
+Behavioral parity: gordo/machine/model/anomaly/diff.py:21-645 — the threshold
+math (per-fold ``rolling(6).min().max()`` of the scaled MSE per timestep and
+the per-tag MAE; smoothed window variants; KFCV percentile thresholds) and the
+anomaly-frame column schema are preserved exactly, because server responses
+and stored metadata are contract surfaces.
+
+TPU note: the heavy part (the base estimator's predict over each CV fold) runs
+as XLA programs; the threshold rolling statistics are small O(n_fold) pandas
+ops on host and not worth device round-trips.
+"""
+
+from datetime import timedelta
+from typing import Optional, Union
+
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.exceptions import NotFittedError
+from sklearn.model_selection import KFold, TimeSeriesSplit, cross_validate as c_val
+from sklearn.preprocessing import MinMaxScaler
+from sklearn.utils import shuffle as sk_shuffle
+
+from gordo_tpu.models import utils as model_utils
+from gordo_tpu.models.anomaly.base import AnomalyDetectorBase
+from gordo_tpu.models.base import GordoBase
+from gordo_tpu.models.models import AutoEncoder
+
+
+class DiffBasedAnomalyDetector(AnomalyDetectorBase):
+    """
+    Anomaly detection by diffing model output against the target, with
+    thresholds from the last TimeSeriesSplit fold's rolling statistics.
+    """
+
+    def __init__(
+        self,
+        base_estimator: BaseEstimator = None,
+        scaler: TransformerMixin = None,
+        require_thresholds: bool = True,
+        shuffle: bool = False,
+        window: Optional[int] = None,
+        smoothing_method: Optional[str] = None,
+    ):
+        self.base_estimator = (
+            base_estimator
+            if base_estimator is not None
+            else AutoEncoder(kind="feedforward_hourglass")
+        )
+        self.scaler = scaler if scaler is not None else MinMaxScaler()
+        self.require_thresholds = require_thresholds
+        self.shuffle = shuffle
+        self.window = window
+        self.smoothing_method = smoothing_method
+        if self.window is not None and self.smoothing_method is None:
+            self.smoothing_method = "smm"
+
+    def __getattr__(self, item):
+        # transparent passthrough to the base estimator (reference diff.py:78-86).
+        # Dunders are never forwarded: sklearn probes __sklearn_clone__ /
+        # __sklearn_tags__ and forwarding them would make clone() return a
+        # clone of the *base estimator* instead of this detector.
+        if item.startswith("__") and item.endswith("__"):
+            raise AttributeError(item)
+        base = self.__dict__.get("base_estimator")
+        if base is None:
+            raise AttributeError(item)
+        return getattr(base, item)
+
+    def get_metadata(self):
+        metadata = dict()
+        if hasattr(self, "feature_thresholds_"):
+            metadata["feature-thresholds"] = self.feature_thresholds_.tolist()
+        if hasattr(self, "aggregate_threshold_"):
+            metadata["aggregate-threshold"] = self.aggregate_threshold_
+        if hasattr(self, "feature_thresholds_per_fold_"):
+            metadata["feature-thresholds-per-fold"] = (
+                self.feature_thresholds_per_fold_.to_dict()
+            )
+        if hasattr(self, "aggregate_thresholds_per_fold_"):
+            metadata["aggregate-thresholds-per-fold"] = (
+                self.aggregate_thresholds_per_fold_
+            )
+        metadata["window"] = self.window
+        metadata["smoothing-method"] = self.smoothing_method
+        if (
+            hasattr(self, "smooth_feature_thresholds_")
+            and self.smooth_feature_thresholds_ is not None
+        ):
+            metadata["smooth-feature-thresholds"] = (
+                self.smooth_feature_thresholds_.tolist()
+            )
+        if (
+            hasattr(self, "smooth_aggregate_threshold_")
+            and self.smooth_aggregate_threshold_ is not None
+        ):
+            metadata["smooth-aggregate-threshold"] = self.smooth_aggregate_threshold_
+        if hasattr(self, "smooth_feature_thresholds_per_fold_"):
+            metadata["smooth-feature-thresholds-per-fold"] = (
+                self.smooth_feature_thresholds_per_fold_.to_dict()
+            )
+        if hasattr(self, "smooth_aggregate_thresholds_per_fold_"):
+            metadata["smooth-aggregate-thresholds-per-fold"] = (
+                self.smooth_aggregate_thresholds_per_fold_
+            )
+        if isinstance(self.base_estimator, GordoBase):
+            metadata.update(self.base_estimator.get_metadata())
+        else:
+            metadata.update(
+                {
+                    "scaler": str(self.scaler),
+                    "base_estimator": str(self.base_estimator),
+                    "shuffle": self.shuffle,
+                }
+            )
+        return metadata
+
+    def score(self, X, y, sample_weight=None) -> float:
+        return self.base_estimator.score(X, y)
+
+    def get_params(self, deep=True):
+        params = {
+            "base_estimator": self.base_estimator,
+            "scaler": self.scaler,
+            "shuffle": self.shuffle,
+        }
+        if self.window is not None:
+            params["window"] = self.window
+            params["smoothing_method"] = self.smoothing_method
+        return params
+
+    def fit(self, X, y):
+        if self.shuffle:
+            X_shuff, y_shuff = sk_shuffle(X, y, random_state=0)
+            self.base_estimator.fit(X_shuff, y_shuff)
+        else:
+            self.base_estimator.fit(X, y)
+        # scaler is fit on y purely for error calculation in .anomaly()
+        self.scaler.fit(y)
+        return self
+
+    def cross_validate(
+        self,
+        *,
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray],
+        cv=None,
+        **kwargs,
+    ):
+        """
+        TimeSeriesSplit CV; updates threshold attributes from fold statistics
+        (reference diff.py:184-276).
+        """
+        if cv is None:
+            cv = TimeSeriesSplit(n_splits=3)
+        kwargs.update(dict(return_estimator=True, cv=cv))
+
+        cv_output = c_val(self, X=X, y=y, **kwargs)
+
+        self.feature_thresholds_per_fold_ = pd.DataFrame()
+        self.aggregate_thresholds_per_fold_ = {}
+        self.smooth_feature_thresholds_per_fold_ = pd.DataFrame()
+        self.smooth_aggregate_thresholds_per_fold_ = {}
+        smooth_aggregate_threshold_fold = None
+        smooth_tag_thresholds_fold = None
+        tag_thresholds_fold = None
+        aggregate_threshold_fold = None
+
+        for i, ((_, test_idxs), split_model) in enumerate(
+            zip(kwargs["cv"].split(X, y), cv_output["estimator"])
+        ):
+            y_pred = split_model.predict(
+                X.iloc[test_idxs] if isinstance(X, pd.DataFrame) else X[test_idxs]
+            )
+            # adjust for model output offset (windowed models emit fewer rows)
+            test_idxs = test_idxs[-len(y_pred):]
+            y_true = y.iloc[test_idxs] if isinstance(y, pd.DataFrame) else y[test_idxs]
+
+            scaled_mse = self._scaled_mse_per_timestep(split_model, y_true, y_pred)
+            mae = self._absolute_error(y_true, y_pred)
+
+            aggregate_threshold_fold = scaled_mse.rolling(6).min().max()
+            self.aggregate_thresholds_per_fold_[f"fold-{i}"] = aggregate_threshold_fold
+
+            tag_thresholds_fold = mae.rolling(6).min().max()
+            tag_thresholds_fold.name = f"fold-{i}"
+            self.feature_thresholds_per_fold_ = pd.concat(
+                [self.feature_thresholds_per_fold_, tag_thresholds_fold.to_frame().T]
+            )
+
+            if self.window is not None:
+                smooth_aggregate_threshold_fold = (
+                    scaled_mse.rolling(self.window).min().max()
+                )
+                self.smooth_aggregate_thresholds_per_fold_[f"fold-{i}"] = (
+                    smooth_aggregate_threshold_fold
+                )
+                smooth_tag_thresholds_fold = mae.rolling(self.window).min().max()
+                smooth_tag_thresholds_fold.name = f"fold-{i}"
+                self.smooth_feature_thresholds_per_fold_ = pd.concat(
+                    [
+                        self.smooth_feature_thresholds_per_fold_,
+                        smooth_tag_thresholds_fold.to_frame().T,
+                    ]
+                )
+
+        # final thresholds come from the last fold
+        self.feature_thresholds_ = tag_thresholds_fold
+        self.aggregate_threshold_ = aggregate_threshold_fold
+        self.smooth_aggregate_threshold_ = smooth_aggregate_threshold_fold
+        self.smooth_feature_thresholds_ = smooth_tag_thresholds_fold
+
+        return cv_output
+
+    @staticmethod
+    def _scaled_mse_per_timestep(model, y_true, y_pred) -> pd.Series:
+        try:
+            scaled_y_true = model.scaler.transform(y_true)
+        except (NotFittedError, ValueError):
+            scaled_y_true = model.scaler.fit_transform(y_true)
+        scaled_y_pred = model.scaler.transform(y_pred)
+        mse_per_time_step = ((scaled_y_pred - scaled_y_true) ** 2).mean(axis=1)
+        return pd.Series(np.asarray(mse_per_time_step))
+
+    @staticmethod
+    def _absolute_error(y_true, y_pred) -> pd.DataFrame:
+        return pd.DataFrame(np.abs(np.asarray(y_true) - np.asarray(y_pred)))
+
+    def _smoothing(self, metric):
+        if self.smoothing_method == "smm":
+            return metric.rolling(self.window).median()
+        elif self.smoothing_method == "sma":
+            return metric.rolling(self.window).mean()
+        elif self.smoothing_method == "ewma":
+            return metric.ewm(span=self.window).mean()
+        raise ValueError(f"Unknown smoothing method {self.smoothing_method!r}")
+
+    def anomaly(
+        self,
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray],
+        frequency: Optional[timedelta] = None,
+    ) -> pd.DataFrame:
+        """
+        Build the anomaly frame: model-input/-output, tag-anomaly-{scaled,
+        unscaled}, total-anomaly-{scaled,unscaled}, smooth-* variants,
+        anomaly-confidence and total-anomaly-confidence
+        (reference diff.py:320-462).
+        """
+        model_output = (
+            self.predict(X) if hasattr(self, "predict") else self.transform(X)
+        )
+
+        data = model_utils.make_base_dataframe(
+            tags=X.columns,
+            model_input=getattr(X, "values", X),
+            model_output=model_output,
+            target_tag_list=y.columns,
+            index=getattr(X, "index", None),
+            frequency=frequency,
+        )
+
+        model_out_scaled = pd.DataFrame(
+            self.scaler.transform(data["model-output"]),
+            columns=data["model-output"].columns,
+            index=data.index,
+        )
+
+        scaled_y = self.scaler.transform(y)
+        tag_anomaly_scaled = np.abs(model_out_scaled - scaled_y[-len(data):, :])
+        tag_anomaly_scaled.columns = pd.MultiIndex.from_product(
+            (("tag-anomaly-scaled",), tag_anomaly_scaled.columns)
+        )
+        data = data.join(tag_anomaly_scaled)
+
+        data["total-anomaly-scaled"] = np.square(data["tag-anomaly-scaled"]).mean(axis=1)
+
+        unscaled_abs_diff = pd.DataFrame(
+            data=np.abs(
+                data["model-output"].to_numpy() - np.asarray(y)[-len(data):, :]
+            ),
+            index=data.index,
+            columns=pd.MultiIndex.from_product(
+                (("tag-anomaly-unscaled",), list(y.columns))
+            ),
+        )
+        data = data.join(unscaled_abs_diff)
+
+        data["total-anomaly-unscaled"] = np.square(data["tag-anomaly-unscaled"]).mean(
+            axis=1
+        )
+
+        if self.window is not None and self.smoothing_method is not None:
+            smooth_tag_anomaly_scaled = self._smoothing(tag_anomaly_scaled)
+            smooth_tag_anomaly_scaled.columns = (
+                smooth_tag_anomaly_scaled.columns.set_levels(
+                    ["smooth-tag-anomaly-scaled"], level=0
+                )
+            )
+            data = data.join(smooth_tag_anomaly_scaled)
+
+            data["smooth-total-anomaly-scaled"] = self._smoothing(
+                data["total-anomaly-scaled"]
+            )
+
+            smooth_tag_anomaly_unscaled = self._smoothing(unscaled_abs_diff)
+            smooth_tag_anomaly_unscaled.columns = (
+                smooth_tag_anomaly_unscaled.columns.set_levels(
+                    ["smooth-tag-anomaly-unscaled"], level=0
+                )
+            )
+            data = data.join(smooth_tag_anomaly_unscaled)
+
+            data["smooth-total-anomaly-unscaled"] = self._smoothing(
+                data["total-anomaly-unscaled"]
+            )
+
+        confidence, index = None, None
+        if hasattr(self, "feature_thresholds_") and self.feature_thresholds_ is not None:
+            confidence = unscaled_abs_diff.values / self.feature_thresholds_.values
+            index = unscaled_abs_diff.index
+
+        if confidence is not None and index is not None:
+            anomaly_confidence_scores = pd.DataFrame(
+                confidence,
+                index=index,
+                columns=pd.MultiIndex.from_product(
+                    (("anomaly-confidence",), data["model-output"].columns)
+                ),
+            )
+            data = data.join(anomaly_confidence_scores)
+
+        total_anomaly_confidence = None
+        if hasattr(self, "aggregate_threshold_") and self.aggregate_threshold_ is not None:
+            total_anomaly_confidence = (
+                data["total-anomaly-scaled"] / self.aggregate_threshold_
+            )
+        if total_anomaly_confidence is not None:
+            data["total-anomaly-confidence"] = total_anomaly_confidence
+
+        if self.require_thresholds and not any(
+            hasattr(self, attr)
+            for attr in ("feature_thresholds_", "aggregate_threshold_")
+        ):
+            raise AttributeError(
+                f"`require_thresholds={self.require_thresholds}` however "
+                f"`.cross_validate` needs to be called in order to calculate "
+                f"these thresholds before calling `.anomaly`"
+            )
+
+        return data
+
+
+class DiffBasedKFCVAnomalyDetector(DiffBasedAnomalyDetector):
+    """
+    KFold variant: thresholds are a percentile of the smoothed validation
+    errors over all folds (reference diff.py:465-645).
+    """
+
+    def __init__(
+        self,
+        base_estimator: BaseEstimator = None,
+        scaler: TransformerMixin = None,
+        require_thresholds: bool = True,
+        shuffle: bool = True,
+        window: int = 144,
+        smoothing_method: str = "smm",
+        threshold_percentile: float = 0.99,
+    ):
+        self.base_estimator = (
+            base_estimator
+            if base_estimator is not None
+            else AutoEncoder(kind="feedforward_hourglass")
+        )
+        self.scaler = scaler if scaler is not None else MinMaxScaler()
+        self.require_thresholds = require_thresholds
+        self.window = window
+        self.shuffle = shuffle
+        self.smoothing_method = smoothing_method
+        self.threshold_percentile = threshold_percentile
+
+    def get_params(self, deep=True):
+        return {
+            "base_estimator": self.base_estimator,
+            "scaler": self.scaler,
+            "window": self.window,
+            "smoothing_method": self.smoothing_method,
+            "shuffle": self.shuffle,
+            "threshold_percentile": self.threshold_percentile,
+        }
+
+    def get_metadata(self):
+        metadata = dict()
+        if hasattr(self, "feature_thresholds_"):
+            metadata["feature-thresholds"] = self.feature_thresholds_.tolist()
+        if hasattr(self, "aggregate_threshold_"):
+            metadata["aggregate-threshold"] = self.aggregate_threshold_
+        if isinstance(self.base_estimator, GordoBase):
+            metadata.update(self.base_estimator.get_metadata())
+        else:
+            metadata.update(
+                {
+                    "scaler": str(self.scaler),
+                    "base_estimator": str(self.base_estimator),
+                    "shuffle": self.shuffle,
+                    "window": self.window,
+                    "smoothing-method": self.smoothing_method,
+                    "threshold-percentile": self.threshold_percentile,
+                }
+            )
+        return metadata
+
+    def cross_validate(
+        self,
+        *,
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray],
+        cv=None,
+        **kwargs,
+    ):
+        if cv is None:
+            cv = KFold(n_splits=5, shuffle=True, random_state=0)
+        kwargs.update(dict(return_estimator=True, cv=cv))
+
+        cv_output = c_val(self, X=X, y=y, **kwargs)
+
+        y = pd.DataFrame(y)
+        y_pred = pd.DataFrame(
+            np.zeros_like(y),
+            index=y.index,
+            columns=y.columns,
+        )
+        y_val_mse = pd.Series(np.nan, index=y.index)
+
+        for i, ((_, test_idxs), split_model) in enumerate(
+            zip(kwargs["cv"].split(X, y), cv_output["estimator"])
+        ):
+            y_pred.iloc[test_idxs] = split_model.predict(
+                X.iloc[test_idxs].to_numpy()
+                if isinstance(X, pd.DataFrame)
+                else X[test_idxs]
+            )
+            y_val_mse.iloc[test_idxs] = self._scaled_mse_per_timestep(
+                split_model, y.iloc[test_idxs], y_pred.iloc[test_idxs]
+            ).to_numpy()
+
+        self.aggregate_threshold_ = self._calculate_threshold(y_val_mse)
+        self.feature_thresholds_ = self._calculate_feature_thresholds(y, y_pred)
+
+        return cv_output
+
+    def _calculate_feature_thresholds(self, y_true, y_pred):
+        absolute_error = self._absolute_error(y_true, y_pred)
+        return self._calculate_threshold(absolute_error)
+
+    def _calculate_threshold(self, validation_metric):
+        val_metric = self._smoothing(validation_metric)
+        return val_metric.quantile(self.threshold_percentile)

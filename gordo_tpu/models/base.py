@@ -1,0 +1,30 @@
+"""
+Base ABC for all gordo_tpu models.
+
+Reference parity: gordo/machine/model/base.py:10-34 (GordoBase).
+"""
+
+import abc
+from typing import Optional, Union
+
+import numpy as np
+import pandas as pd
+
+
+class GordoBase(abc.ABC):
+    @abc.abstractmethod
+    def get_params(self, deep=False) -> dict:
+        """Return model parameters (sklearn convention)."""
+
+    @abc.abstractmethod
+    def score(
+        self,
+        X: Union[np.ndarray, pd.DataFrame],
+        y: Union[np.ndarray, pd.DataFrame],
+        sample_weight: Optional[np.ndarray] = None,
+    ) -> float:
+        """Score the model (higher is better)."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> dict:
+        """Return any model metadata (training history, thresholds...)."""

@@ -1,0 +1,72 @@
+"""
+Training callbacks for the host-side epoch loop.
+
+The reference lets configs attach Keras callbacks
+(gordo/serializer/from_definition.py:197-217); here the equivalent objects
+plug into ``gordo_tpu.ops.train.fit_arrays``. Reference
+``tensorflow.keras.callbacks.EarlyStopping`` paths are aliased to
+:class:`EarlyStopping` by the serializer resolver.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+
+class EarlyStopping:
+    """Stop training when a monitored metric has stopped improving."""
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        min_delta: float = 0.0,
+        patience: int = 0,
+        mode: str = "auto",
+        restore_best_weights: bool = False,
+        **kwargs,
+    ):
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        self.patience = patience
+        self.restore_best_weights = restore_best_weights
+        self.mode = mode
+        self._wait = 0
+        self._best: Optional[float] = None
+        self._best_params = None
+
+    def get_params(self, deep=False):
+        return {
+            "monitor": self.monitor,
+            "min_delta": self.min_delta,
+            "patience": self.patience,
+            "restore_best_weights": self.restore_best_weights,
+        }
+
+    def on_train_begin(self):
+        self._wait = 0
+        self._best = None
+        self._best_params = None
+
+    def on_epoch_end(self, epoch: int, logs: dict, params) -> bool:
+        current = logs.get(self.monitor, logs.get("loss"))
+        if current is None or not np.isfinite(current):
+            return False
+        if self._best is None or current < self._best - self.min_delta:
+            self._best = current
+            self._wait = 0
+            if self.restore_best_weights:
+                # deep-copy: the live pytree's buffers are donated to the next
+                # epoch's jitted step (ops/train.py donate_argnums) and would
+                # otherwise be invalidated on TPU/GPU
+                import jax
+                import jax.numpy as jnp
+
+                self._best_params = jax.tree_util.tree_map(jnp.copy, params)
+            return False
+        self._wait += 1
+        return self._wait >= self.patience
+
+    def on_train_end(self, params):
+        if self.restore_best_weights and self._best_params is not None:
+            return self._best_params
+        return None

@@ -1,0 +1,374 @@
+"""
+The model zoo: sklearn-compatible JAX estimators.
+
+API parity with gordo/machine/model/models.py (KerasAutoEncoder →
+:class:`AutoEncoder`, KerasLSTMAutoEncoder → :class:`LSTMAutoEncoder`,
+KerasLSTMForecast → :class:`LSTMForecast`, KerasRawModelRegressor →
+:class:`RawModelRegressor`); reference import paths are aliased by the
+serializer so existing gordo configs resolve to these classes.
+
+TPU-native design: ``fit`` resolves the registered factory into a hashable
+:class:`~gordo_tpu.models.spec.ModelSpec`, initializes a parameter pytree, and
+runs the fused ``lax.scan`` training program from ``gordo_tpu.ops.train``.
+Parameters are plain arrays — pickling works without the reference's
+h5-in-pickle workaround (models.py:183-208), and the same pytrees stack
+directly into the vmap-batched multi-machine trainer.
+
+Timeseries window semantics (lookback/lookahead) match the reference's
+``create_keras_timeseriesgenerator`` (models.py:715-796): a model with
+lookback L and lookahead a outputs len(X) - L + 1 - a rows.
+"""
+
+import logging
+from copy import copy
+from pprint import pformat
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.exceptions import NotFittedError
+from sklearn.metrics import explained_variance_score
+
+from gordo_tpu.models.base import GordoBase
+from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.spec import DenseLayer, LSTMLayer, ModelSpec, OptimizerSpec
+from gordo_tpu.ops import nn, train as train_ops
+
+# factories register themselves on import
+from gordo_tpu.models import factories  # noqa: F401
+
+logger = logging.getLogger(__name__)
+
+
+class BaseJaxEstimator(GordoBase, BaseEstimator):
+    """
+    Common fit/predict machinery. Subclasses set ``factory_type`` (the
+    registry bucket, reference: register.py factories dict keyed by class
+    name) and may override window properties.
+    """
+
+    supported_fit_args = [
+        "batch_size",
+        "epochs",
+        "verbose",
+        "callbacks",
+        "validation_split",
+        "shuffle",
+    ]
+
+    factory_type: str = "AutoEncoder"
+
+    def __init__(self, kind: Union[str, Callable, dict], **kwargs) -> None:
+        self.history: Optional[Dict[str, list]] = None
+        self.kind = self.load_kind(kind)
+        self.kwargs: Dict[str, Any] = kwargs
+
+    # ------------------------------------------------------------- plumbing
+    def load_kind(self, kind):
+        if callable(kind):
+            register_model_builder(type=self.factory_type)(kind)
+            return kind.__name__
+        if isinstance(kind, str):
+            if kind not in register_model_builder.factories.get(self.factory_type, {}):
+                raise ValueError(
+                    f"kind: {kind} is not an available model for "
+                    f"type: {self.factory_type}!"
+                )
+            return kind
+        raise ValueError(f"Unsupported kind: {kind!r}")
+
+    @classmethod
+    def from_definition(cls, definition: dict):
+        definition = copy(definition)
+        kind = definition.pop("kind")
+        return cls(kind, **definition)
+
+    def into_definition(self) -> dict:
+        definition = copy(self.kwargs)
+        definition["kind"] = self.kind
+        return definition
+
+    def get_params(self, deep=True):
+        params = {"kind": self.kind}
+        params.update(self.kwargs)
+        return params
+
+    def set_params(self, **params):
+        params = dict(params)
+        if "kind" in params:
+            self.kind = self.load_kind(params.pop("kind"))
+        self.kwargs.update(params)
+        return self
+
+    def extract_supported_fit_args(self, kwargs):
+        return {k: kwargs[k] for k in self.supported_fit_args if k in kwargs}
+
+    def _factory_kwargs(self):
+        out = {
+            k: v
+            for k, v in self.kwargs.items()
+            if k not in self.supported_fit_args
+        }
+        return out
+
+    # ----------------------------------------------------------- building
+    @property
+    def lookback_window(self) -> int:
+        return 1
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+    def build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
+        factory = register_model_builder.factories[self.factory_type][self.kind]
+        kwargs = self._factory_kwargs()
+        kwargs.setdefault("n_features", n_features)
+        kwargs.setdefault("n_features_out", n_features_out)
+        return factory(**kwargs)
+
+    # ---------------------------------------------------------------- fit
+    @staticmethod
+    def _as_2d_array(data) -> np.ndarray:
+        arr = data.values if isinstance(data, pd.DataFrame) else np.asarray(data)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        return np.asarray(arr, np.float32)
+
+    def fit(self, X, y, **kwargs):
+        X = self._as_2d_array(X)
+        y = self._as_2d_array(y)
+
+        spec = self.build_spec(X.shape[1], y.shape[1])
+        self.spec_ = spec
+
+        fit_args = dict(self.extract_supported_fit_args(self.kwargs))
+        fit_args.update(self.extract_supported_fit_args(kwargs))
+        callbacks = fit_args.get("callbacks") or []
+        if callbacks:
+            from gordo_tpu.serializer.from_definition import _build_callbacks
+
+            callbacks = [
+                cb if not isinstance(cb, (dict, str)) else _build_callbacks([cb])[0]
+                for cb in callbacks
+            ]
+
+        # deterministic per-fit seed drawn from the (builder-seeded) global
+        # numpy RNG — parity with the reference's set_seed contract
+        # (gordo/builder/build_model.py:314-318)
+        seed = int(np.random.randint(0, 2**31 - 1))
+        rng = jax.random.PRNGKey(seed)
+        rng, init_rng = jax.random.split(rng)
+        params = nn.init_model_params(init_rng, spec)
+
+        result = train_ops.fit_arrays(
+            spec,
+            params,
+            X,
+            y,
+            epochs=int(fit_args.get("epochs", 1)),
+            batch_size=int(fit_args.get("batch_size", 32)),
+            shuffle=bool(fit_args.get("shuffle", True)),
+            validation_split=float(fit_args.get("validation_split", 0.0) or 0.0),
+            rng=rng,
+            callbacks=callbacks,
+        )
+        self.params_ = result.params
+        self.history = dict(result.history)
+        self.history["params"] = {
+            "epochs": result.epochs_trained,
+            "batch_size": int(fit_args.get("batch_size", 32)),
+            "metrics": list(result.history.keys()),
+        }
+        return self
+
+    # ------------------------------------------------------------ predict
+    def predict(self, X, **kwargs) -> np.ndarray:
+        if not hasattr(self, "params_"):
+            raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
+        X = self._as_2d_array(X)
+        return train_ops.predict_fn(self.spec_)(self.params_, X)
+
+    def transform(self, X) -> np.ndarray:
+        return self.predict(X)
+
+    def score(self, X, y, sample_weight=None) -> float:
+        if not hasattr(self, "params_"):
+            raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
+        out = self.predict(X)
+        y = self._as_2d_array(y)
+        return explained_variance_score(y[-len(out):], out)
+
+    # ----------------------------------------------------------- metadata
+    def get_metadata(self):
+        if self.history is not None:
+            return {"history": dict(self.history)}
+        return {}
+
+    # ----------------------------------------------------------- pickling
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        if "params_" in state:
+            state["params_"] = jax.device_get(state["params_"])
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        return self
+
+
+class AutoEncoder(BaseJaxEstimator, TransformerMixin):
+    """
+    Dense autoencoder (reference: KerasAutoEncoder, models.py:364-399).
+    Output has the same length as the input.
+    """
+
+    factory_type = "AutoEncoder"
+
+    def score(self, X, y, sample_weight=None) -> float:
+        if not hasattr(self, "params_"):
+            raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
+        out = self.predict(X)
+        y = self._as_2d_array(y)
+        return explained_variance_score(y, out)
+
+
+class LSTMBaseEstimator(BaseJaxEstimator, TransformerMixin):
+    """
+    Windowed (many-to-one) LSTM estimator base
+    (reference: KerasLSTMBaseEstimator, models.py:461-697).
+
+    Output length is ``len(X) - lookback_window + 1 - lookahead``.
+    """
+
+    def __init__(self, kind, lookback_window: int = 1, batch_size: int = 1, **kwargs):
+        kwargs["lookback_window"] = lookback_window
+        kwargs["batch_size"] = batch_size
+        super().__init__(kind, **kwargs)
+
+    @property
+    def lookback_window(self) -> int:
+        return int(self.kwargs.get("lookback_window", 1))
+
+    @property
+    def lookahead(self) -> int:
+        raise NotImplementedError()
+
+    def _factory_kwargs(self):
+        out = super()._factory_kwargs()
+        out["lookahead"] = self.lookahead
+        return out
+
+    def get_metadata(self):
+        metadata = super().get_metadata()
+        metadata.update(
+            {"forecast_steps": self.lookahead}
+            if self.lookahead is not None
+            else {}
+        )
+        return metadata
+
+
+class LSTMAutoEncoder(LSTMBaseEstimator):
+    """Reference: KerasLSTMAutoEncoder (lookahead=0), models.py:709."""
+
+    factory_type = "LSTMAutoEncoder"
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+
+class LSTMForecast(LSTMBaseEstimator):
+    """Reference: KerasLSTMForecast (lookahead=1), models.py:703."""
+
+    factory_type = "LSTMForecast"
+
+    @property
+    def lookahead(self) -> int:
+        return 1
+
+
+class RawModelRegressor(AutoEncoder):
+    """
+    Build an arbitrary layer stack from a raw config dict
+    (reference: KerasRawModelRegressor, models.py:402-458).
+
+    Examples
+    --------
+    >>> import yaml, numpy as np
+    >>> config = yaml.safe_load('''
+    ... compile:
+    ...   loss: mse
+    ...   optimizer: adam
+    ... spec:
+    ...   layers:
+    ...     - Dense:
+    ...         units: 4
+    ...         activation: tanh
+    ...     - Dense:
+    ...         units: 1
+    ... ''')
+    >>> model = RawModelRegressor(kind=config)
+    >>> X, y = np.random.random((10, 4)), np.random.random((10, 1))
+    >>> _ = model.fit(X, y)
+    >>> model.predict(X).shape
+    (10, 1)
+    """
+
+    _expected_keys = ("spec", "compile")
+
+    def load_kind(self, kind):
+        if not isinstance(kind, dict):
+            raise ValueError("RawModelRegressor kind must be a config dict")
+        return kind
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(kind: {pformat(self.kind)})"
+
+    @staticmethod
+    def _parse_layer(layer_def) -> Union[DenseLayer, LSTMLayer]:
+        if not isinstance(layer_def, dict) or len(layer_def) != 1:
+            raise ValueError(f"Invalid layer definition: {layer_def!r}")
+        name = list(layer_def)[0]
+        kwargs = dict(layer_def[name] or {})
+        short = name.rsplit(".", 1)[-1]
+        if short == "Dense":
+            return DenseLayer(
+                units=int(kwargs["units"]),
+                activation=kwargs.get("activation", "linear"),
+            )
+        if short == "LSTM":
+            return LSTMLayer(
+                units=int(kwargs["units"]),
+                activation=kwargs.get("activation", "tanh"),
+                return_sequences=bool(kwargs.get("return_sequences", False)),
+            )
+        raise ValueError(f"Unsupported raw layer type: {name!r}")
+
+    def build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
+        if not all(k in self.kind for k in self._expected_keys):
+            raise ValueError(
+                f"Expected spec to have keys: {self._expected_keys}, "
+                f"but found {list(self.kind)}"
+            )
+        spec_def = self.kind["spec"]
+        if isinstance(spec_def, dict) and "layers" not in spec_def:
+            # accept reference-style {Sequential: {layers: [...]}} nesting
+            inner = list(spec_def.values())[0]
+            spec_def = inner if isinstance(inner, dict) else {"layers": inner}
+        layers = tuple(self._parse_layer(ld) for ld in spec_def["layers"])
+        compile_kwargs = dict(self.kind.get("compile") or {})
+        optimizer = compile_kwargs.get("optimizer", "Adam")
+        loss = compile_kwargs.get("loss", "mse")
+        lookback = int(self.kind.get("lookback_window", 1))
+        return ModelSpec(
+            layers=layers,
+            n_features=n_features,
+            n_features_out=layers[-1].units,
+            lookback_window=lookback,
+            optimizer=OptimizerSpec.create(str(optimizer), compile_kwargs.get("optimizer_kwargs")),
+            loss=loss,
+        )

@@ -1,0 +1,44 @@
+"""
+Model-builder registry: maps estimator class name → {kind name → factory}.
+
+Reference parity: gordo/machine/model/register.py:11-75
+(register_model_builder). A factory takes ``n_features`` as its first
+argument and returns a ``ModelSpec`` (declarative architecture), not a
+compiled model — specs are pytree-friendly and feed both the single-model
+estimators and the vmap-batched multi-machine trainer.
+"""
+
+import inspect
+from typing import Callable, Dict
+
+
+class register_model_builder:
+    """
+    Decorator, used as ``@register_model_builder(type="AutoEncoder")``.
+
+    >>> from gordo_tpu.models.register import register_model_builder
+    >>> @register_model_builder(type="AutoEncoder")
+    ... def special_model(n_features, **kwargs):
+    ...     pass
+    >>> 'special_model' in register_model_builder.factories['AutoEncoder']
+    True
+    """
+
+    factories: Dict[str, Dict[str, Callable]] = dict()
+
+    def __init__(self, type: str):
+        self.type = type
+
+    def __call__(self, build_fn: Callable):
+        self._validate_func(build_fn)
+        self.factories.setdefault(self.type, dict())[build_fn.__name__] = build_fn
+        return build_fn
+
+    @staticmethod
+    def _validate_func(func):
+        params = inspect.signature(func).parameters
+        if "n_features" not in params:
+            raise ValueError(
+                f"Model builder function {func.__name__} must accept 'n_features' "
+                f"as a parameter"
+            )

@@ -1,0 +1,80 @@
+"""
+Declarative model specifications.
+
+Where the reference's factories build *compiled Keras models*
+(gordo/machine/model/factories/), gordo_tpu factories build ``ModelSpec``
+values: frozen, hashable descriptions of architecture + optimizer. Specs are
+static arguments to jitted training functions, so two machines with the same
+spec share one compiled XLA program — the property the batched multi-machine
+trainer exploits (bucket by spec, vmap over the parameter stack).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple, Union
+
+
+@dataclass(frozen=True)
+class DenseLayer:
+    units: int
+    activation: str = "linear"
+    # l1 activity regularization coefficient (reference applies l1(10e-5) on
+    # non-first encoder layers, factories/feedforward_autoencoder.py:78-85)
+    l1_activity: float = 0.0
+
+
+@dataclass(frozen=True)
+class LSTMLayer:
+    units: int
+    activation: str = "tanh"
+    recurrent_activation: str = "sigmoid"
+    return_sequences: bool = False
+
+
+LayerSpec = Union[DenseLayer, LSTMLayer]
+
+
+@dataclass(frozen=True)
+class OptimizerSpec:
+    name: str = "Adam"
+    # stored as a sorted tuple of (key, value) pairs to stay hashable
+    kwargs: Tuple[Tuple[str, Any], ...] = ()
+
+    @classmethod
+    def create(cls, name: str = "Adam", kwargs: Optional[Dict[str, Any]] = None):
+        items = tuple(sorted((kwargs or {}).items()))
+        return cls(name=name, kwargs=items)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self.kwargs)
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """
+    A full architecture: an ordered tuple of layers plus IO dims, windowing,
+    and optimizer/loss configuration.
+
+    ``lookback_window`` / ``lookahead`` carry the timeseries window semantics
+    of the reference's LSTM estimators (gordo/machine/model/models.py:461-796);
+    dense models use lookback_window=1.
+    """
+
+    layers: Tuple[LayerSpec, ...]
+    n_features: int
+    n_features_out: int
+    lookback_window: int = 1
+    lookahead: int = 0
+    optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
+    loss: str = "mse"
+
+    @property
+    def is_recurrent(self) -> bool:
+        return any(isinstance(l, LSTMLayer) for l in self.layers)
+
+    @property
+    def output_offset(self) -> int:
+        """How many fewer rows the model outputs than it is given
+        (= lookback_window - 1 + lookahead for windowed models, 0 for dense)."""
+        if self.lookback_window <= 1 and self.lookahead == 0:
+            return 0
+        return self.lookback_window - 1 + self.lookahead

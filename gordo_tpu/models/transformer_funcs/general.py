@@ -1,0 +1,13 @@
+"""
+Functions usable with ``sklearn.preprocessing.FunctionTransformer``.
+
+Reference parity: gordo/machine/model/transformer_funcs/general.py:24-27.
+
+>>> import numpy as np
+>>> multiply_by(np.array([1.0, 2.0]), factor=2)
+array([2., 4.])
+"""
+
+def multiply_by(X, factor: float = 1.0):
+    """Multiply the input by a constant factor."""
+    return X * factor

@@ -1,0 +1,96 @@
+"""
+Model-layer helpers: offset-aware metric wrapping and the MultiIndex response
+dataframe assembly.
+
+Behavioral parity: gordo/machine/model/utils.py:18-156 (metric_wrapper,
+make_base_dataframe) — the response schema here defines the server payload
+format, so column structure matches exactly.
+"""
+
+import functools
+from datetime import datetime, timedelta
+from typing import List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.dataset.sensor_tag import SensorTag
+
+
+def metric_wrapper(metric, scaler=None):
+    """
+    Wrap a metric so it tolerates model output shorter than y (windowed
+    models) and optionally scales y/y_pred first.
+    """
+
+    @functools.wraps(metric)
+    def _wrapper(y_true, y_pred, *args, **kwargs):
+        if scaler:
+            y_true = scaler.transform(y_true)
+            y_pred = scaler.transform(y_pred)
+        return metric(y_true[-len(y_pred):], y_pred, *args, **kwargs)
+
+    return _wrapper
+
+
+def make_base_dataframe(
+    tags: Union[List[SensorTag], List[str]],
+    model_input: np.ndarray,
+    model_output: np.ndarray,
+    target_tag_list: Optional[Union[List[SensorTag], List[str]]] = None,
+    index: Optional[np.ndarray] = None,
+    frequency: Optional[timedelta] = None,
+) -> pd.DataFrame:
+    """
+    Build the canonical MultiIndex response frame with 'start'/'end' time
+    columns and 'model-input'/'model-output' blocks, aligning lengths when the
+    model output fewer rows than it was given.
+    """
+    target_tag_list = target_tag_list if target_tag_list is not None else tags
+
+    model_input = getattr(model_input, "values", model_input)[-len(model_output):, :]
+    model_output = getattr(model_output, "values", model_output)
+
+    names_n_values = (("model-input", model_input), ("model-output", model_output))
+
+    index = (
+        index[-len(model_output):] if index is not None else range(len(model_output))
+    )
+
+    start_series = pd.Series(
+        index
+        if isinstance(index, pd.DatetimeIndex)
+        else (None for _ in range(len(index))),
+        index=index,
+    )
+    end_series = start_series.map(
+        lambda start: (start + frequency).isoformat()
+        if isinstance(start, datetime) and frequency is not None
+        else None
+    )
+    start_series = start_series.map(
+        lambda start: start.isoformat() if hasattr(start, "isoformat") else None
+    )
+
+    columns = pd.MultiIndex.from_product((("start", "end"), ("",)))
+    data: pd.DataFrame = pd.DataFrame(
+        {("start", ""): start_series, ("end", ""): end_series},
+        columns=columns,
+        index=index,
+    )
+
+    for name, values in filter(lambda nv: nv[1] is not None, names_n_values):
+        _tags = tags if name == "model-input" else target_tag_list
+        if values.shape[1] == len(_tags):
+            second_lvl_names = map(
+                str, (tag.name if isinstance(tag, SensorTag) else tag for tag in _tags)
+            )
+        else:
+            second_lvl_names = map(str, range(values.shape[1]))
+        columns = pd.MultiIndex.from_tuples(
+            (name, sub_name) for sub_name in second_lvl_names
+        )
+        other = pd.DataFrame(values[-len(model_output):], columns=columns, index=index)
+        data = data.join(other)
+
+    return data

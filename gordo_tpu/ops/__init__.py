@@ -1,0 +1,22 @@
+"""
+gordo_tpu.ops: pure JAX building blocks — layer init/apply, the fused
+training engine, and windowing ops. Everything here is functional (params in,
+params out), static-shaped, and safe to ``vmap``/``jit``/``shard_map``.
+"""
+
+from .nn import (
+    ACTIVATIONS,
+    init_model_params,
+    apply_model,
+)
+from .train import fit_arrays, evaluate_loss, make_optimizer, TrainResult
+
+__all__ = [
+    "ACTIVATIONS",
+    "init_model_params",
+    "apply_model",
+    "fit_arrays",
+    "evaluate_loss",
+    "make_optimizer",
+    "TrainResult",
+]

@@ -1,0 +1,150 @@
+"""
+Layer init/apply as pure functions over parameter pytrees.
+
+Initialization matches Keras defaults (the reference's models are Keras
+Sequential stacks, gordo/machine/model/factories/): Dense → glorot-uniform
+kernel, zero bias; LSTM → glorot-uniform input kernel, orthogonal recurrent
+kernel, zero bias with unit forget-gate bias.
+
+Everything is shape-static and vmap-safe: parameters are dicts of jnp arrays,
+and ``apply_model`` is a pure function of (spec, params, x).
+"""
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gordo_tpu.models.spec import DenseLayer, LSTMLayer, ModelSpec
+
+Params = List[Dict[str, Any]]
+
+ACTIVATIONS = {
+    "linear": lambda x: x,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.swish,
+    "gelu": jax.nn.gelu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "exponential": jnp.exp,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+}
+
+
+def _activation(name: str):
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; available: {sorted(ACTIVATIONS)}"
+        ) from None
+
+
+def _glorot_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = shape[-2], shape[-1]
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def _orthogonal(rng, shape, dtype=jnp.float32):
+    return jax.nn.initializers.orthogonal()(rng, shape, dtype)
+
+
+def init_dense_layer(rng, in_dim: int, units: int) -> Dict[str, jnp.ndarray]:
+    return {
+        "kernel": _glorot_uniform(rng, (in_dim, units)),
+        "bias": jnp.zeros((units,), jnp.float32),
+    }
+
+
+def init_lstm_layer(rng, in_dim: int, units: int) -> Dict[str, jnp.ndarray]:
+    k1, k2 = jax.random.split(rng)
+    bias = jnp.zeros((4 * units,), jnp.float32)
+    # unit forget-gate bias (Keras unit_forget_bias=True); gate order i,f,g,o
+    bias = bias.at[units : 2 * units].set(1.0)
+    return {
+        "kernel": _glorot_uniform(k1, (in_dim, 4 * units)),
+        "recurrent_kernel": _orthogonal(k2, (units, 4 * units)),
+        "bias": bias,
+    }
+
+
+def init_model_params(rng: jax.Array, spec: ModelSpec) -> Params:
+    """Initialize the full parameter pytree for a ModelSpec."""
+    params: Params = []
+    in_dim = spec.n_features
+    rngs = jax.random.split(rng, len(spec.layers))
+    for layer, layer_rng in zip(spec.layers, rngs):
+        if isinstance(layer, DenseLayer):
+            params.append(init_dense_layer(layer_rng, in_dim, layer.units))
+        elif isinstance(layer, LSTMLayer):
+            params.append(init_lstm_layer(layer_rng, in_dim, layer.units))
+        else:
+            raise TypeError(f"Unknown layer spec: {layer!r}")
+        in_dim = layer.units
+    return params
+
+
+def _apply_dense(layer: DenseLayer, p, x):
+    out = x @ p["kernel"] + p["bias"]
+    return _activation(layer.activation)(out)
+
+
+def _apply_lstm(layer: LSTMLayer, p, x):
+    """
+    x: (batch, time, in_dim) → (batch, time, units) or (batch, units).
+
+    scan over time with a fused gate matmul — XLA maps the (batch, in+units) @
+    (in+units, 4*units) product onto the MXU per step.
+    """
+    units = layer.units
+    act = _activation(layer.activation)
+    rec_act = _activation(layer.recurrent_activation)
+    batch = x.shape[0]
+
+    def step(carry, xt):
+        h, c = carry
+        z = xt @ p["kernel"] + h @ p["recurrent_kernel"] + p["bias"]
+        i = rec_act(z[:, :units])
+        f = rec_act(z[:, units : 2 * units])
+        g = act(z[:, 2 * units : 3 * units])
+        o = rec_act(z[:, 3 * units :])
+        c = f * c + i * g
+        h = o * act(c)
+        return (h, c), h
+
+    h0 = jnp.zeros((batch, units), x.dtype)
+    c0 = jnp.zeros((batch, units), x.dtype)
+    (h, _), hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(x, 0, 1))
+    if layer.return_sequences:
+        return jnp.swapaxes(hs, 0, 1)
+    return h
+
+
+def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
+    """
+    Forward pass.
+
+    Returns ``(output, activity_penalty)`` where the penalty is the summed l1
+    activity regularization (reference parity:
+    factories/feedforward_autoencoder.py:78-85 — l1(1e-4) on non-first encoder
+    layers), normalized by batch size to keep loss scale batch-invariant.
+    """
+    penalty = jnp.asarray(0.0, x.dtype)
+    batch = x.shape[0]
+    out = x
+    for layer, p in zip(spec.layers, params):
+        if isinstance(layer, DenseLayer):
+            out = _apply_dense(layer, p, out)
+            if layer.l1_activity > 0.0:
+                penalty = penalty + layer.l1_activity * jnp.sum(jnp.abs(out)) / batch
+        elif isinstance(layer, LSTMLayer):
+            out = _apply_lstm(layer, p, out)
+        else:
+            raise TypeError(f"Unknown layer spec: {layer!r}")
+    return out, penalty

@@ -1,0 +1,329 @@
+"""
+The fused training engine.
+
+One epoch = one XLA program: ``lax.scan`` over minibatches with in-place
+(donated) parameter updates. Static shapes throughout — the sample count is
+padded up to a whole number of batches with zero-weighted index padding, so
+XLA compiles exactly one program per (spec, n_samples-bucket, batch_size).
+
+Windowed (LSTM) models never materialize the window tensor in HBM: each scan
+step gathers its (batch, lookback, features) block from the flat series,
+trading a tiny gather for O(lookback)× memory. Window/lookahead semantics
+match the reference's timeseries generator (gordo/machine/model/models.py:
+715-796): window i covers rows [i, i+lookback) and its target is row
+i + lookback - 1 + lookahead.
+
+Host↔device traffic: X/y are transferred once per ``fit``; per-epoch work is
+a single device call returning a scalar loss.
+"""
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gordo_tpu.models.spec import ModelSpec, OptimizerSpec
+from .nn import apply_model
+
+
+# --------------------------------------------------------------- optimizers
+def make_optimizer(spec: OptimizerSpec) -> optax.GradientTransformation:
+    """Build an optax optimizer from a Keras-style optimizer spec."""
+    kwargs = spec.as_dict()
+    lr = kwargs.pop("learning_rate", kwargs.pop("lr", None))
+    name = spec.name.lower()
+    if name == "adam":
+        return optax.adam(
+            learning_rate=lr if lr is not None else 1e-3,
+            b1=kwargs.get("beta_1", 0.9),
+            b2=kwargs.get("beta_2", 0.999),
+            eps=kwargs.get("epsilon", 1e-7),
+        )
+    if name == "sgd":
+        return optax.sgd(
+            learning_rate=lr if lr is not None else 1e-2,
+            momentum=kwargs.get("momentum", 0.0) or None,
+            nesterov=kwargs.get("nesterov", False),
+        )
+    if name == "rmsprop":
+        return optax.rmsprop(
+            learning_rate=lr if lr is not None else 1e-3,
+            decay=kwargs.get("rho", 0.9),
+            eps=kwargs.get("epsilon", 1e-7),
+            momentum=kwargs.get("momentum", 0.0),
+        )
+    if name == "adagrad":
+        return optax.adagrad(learning_rate=lr if lr is not None else 1e-3)
+    if name == "nadam":
+        return optax.nadam(learning_rate=lr if lr is not None else 1e-3)
+    if name == "adamax":
+        return optax.adamax(learning_rate=lr if lr is not None else 1e-3)
+    if name == "adamw":
+        return optax.adamw(learning_rate=lr if lr is not None else 1e-3)
+    raise ValueError(f"Unknown optimizer {spec.name!r}")
+
+
+def _loss_terms(spec: ModelSpec, params, xb, yb, wb):
+    out, penalty = apply_model(spec, params, xb)
+    if spec.loss in ("mse", "mean_squared_error"):
+        per_sample = jnp.mean((out - yb) ** 2, axis=-1)
+    elif spec.loss in ("mae", "mean_absolute_error"):
+        per_sample = jnp.mean(jnp.abs(out - yb), axis=-1)
+    else:
+        raise ValueError(f"Unknown loss {spec.loss!r}")
+    w_sum = jnp.maximum(jnp.sum(wb), 1.0)
+    return jnp.sum(per_sample * wb) / w_sum + penalty
+
+
+def _gather_batch(spec: ModelSpec, X, y, idx):
+    """Gather a minibatch by sample (or window-start) indices."""
+    if spec.lookback_window <= 1 and spec.lookahead == 0:
+        return X[idx], y[idx]
+    window = jnp.arange(spec.lookback_window)
+    xb = X[idx[:, None] + window[None, :]]  # (B, L, D)
+    yb = y[idx + spec.lookback_window - 1 + spec.lookahead]
+    return xb, yb
+
+
+def n_train_samples(spec: ModelSpec, n_rows: int) -> int:
+    """Number of training samples (windows) obtainable from n_rows rows."""
+    if spec.lookback_window <= 1 and spec.lookahead == 0:
+        return n_rows
+    return max(n_rows - spec.lookback_window + 1 - spec.lookahead, 0)
+
+
+# ----------------------------------------------------------- jitted kernels
+@functools.lru_cache(maxsize=256)
+def _build_epoch_fn(
+    spec: ModelSpec, n_samples: int, batch_size: int, shuffle: bool
+) -> Callable:
+    n_steps = max((n_samples + batch_size - 1) // batch_size, 1)
+    n_pad = n_steps * batch_size
+    opt = make_optimizer(spec.optimizer)
+
+    def epoch(params, opt_state, X, y, rng):
+        base_idx = jnp.arange(n_samples)
+        if shuffle:
+            base_idx = jax.random.permutation(rng, n_samples)
+        # pad index stream with zero-weighted repeats of index 0
+        idx_stream = jnp.concatenate(
+            [base_idx, jnp.zeros((n_pad - n_samples,), base_idx.dtype)]
+        )
+        w_stream = jnp.concatenate(
+            [jnp.ones((n_samples,), jnp.float32), jnp.zeros((n_pad - n_samples,), jnp.float32)]
+        )
+
+        def body(carry, i):
+            params, opt_state, loss_sum, w_sum = carry
+            idx = jax.lax.dynamic_slice(idx_stream, (i * batch_size,), (batch_size,))
+            wb = jax.lax.dynamic_slice(w_stream, (i * batch_size,), (batch_size,))
+            xb, yb = _gather_batch(spec, X, y, idx)
+            loss, grads = jax.value_and_grad(_loss_terms, argnums=1)(
+                spec, params, xb, yb, wb
+            )
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            bw = jnp.sum(wb)
+            return (params, opt_state, loss_sum + loss * bw, w_sum + bw), None
+
+        init = (params, opt_state, jnp.asarray(0.0), jnp.asarray(0.0))
+        (params, opt_state, loss_sum, w_sum), _ = jax.lax.scan(
+            body, init, jnp.arange(n_steps)
+        )
+        return params, opt_state, loss_sum / jnp.maximum(w_sum, 1.0)
+
+    return jax.jit(epoch, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=256)
+def _build_eval_fn(spec: ModelSpec, n_samples: int, batch_size: int = 2048) -> Callable:
+    """Full-dataset loss, batched with the same padding scheme (no grad)."""
+    n_steps = max((n_samples + batch_size - 1) // batch_size, 1)
+    n_pad = n_steps * batch_size
+
+    def evaluate(params, X, y):
+        idx_stream = jnp.concatenate(
+            [jnp.arange(n_samples), jnp.zeros((n_pad - n_samples,), jnp.int32)]
+        )
+        w_stream = jnp.concatenate(
+            [jnp.ones((n_samples,), jnp.float32), jnp.zeros((n_pad - n_samples,), jnp.float32)]
+        )
+
+        def body(carry, i):
+            loss_sum, w_sum = carry
+            idx = jax.lax.dynamic_slice(idx_stream, (i * batch_size,), (batch_size,))
+            wb = jax.lax.dynamic_slice(w_stream, (i * batch_size,), (batch_size,))
+            xb, yb = _gather_batch(spec, X, y, idx)
+            loss = _loss_terms(spec, params, xb, yb, wb)
+            bw = jnp.sum(wb)
+            return (loss_sum + loss * bw, w_sum + bw), None
+
+        (loss_sum, w_sum), _ = jax.lax.scan(body, (jnp.asarray(0.0), jnp.asarray(0.0)), jnp.arange(n_steps))
+        return loss_sum / jnp.maximum(w_sum, 1.0)
+
+    return jax.jit(evaluate)
+
+
+def evaluate_loss(spec: ModelSpec, params, X, y) -> float:
+    n = n_train_samples(spec, len(X))
+    fn = _build_eval_fn(spec, n)
+    return float(fn(params, jnp.asarray(X), jnp.asarray(y)))
+
+
+# ------------------------------------------------------------------ fitting
+@dataclass
+class TrainResult:
+    params: Any
+    history: Dict[str, List[float]] = field(default_factory=dict)
+    epochs_trained: int = 0
+
+
+def fit_arrays(
+    spec: ModelSpec,
+    params,
+    X: np.ndarray,
+    y: np.ndarray,
+    *,
+    epochs: int = 1,
+    batch_size: int = 32,
+    shuffle: bool = True,
+    validation_split: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    callbacks: Optional[List] = None,
+) -> TrainResult:
+    """
+    Train ``params`` on (X, y). Host loop over epochs; each epoch is one
+    device call. Supports Keras-style validation_split (holds out the *last*
+    fraction of samples, as Keras does) and EarlyStopping-style callbacks.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    callbacks = callbacks or []
+
+    n_rows = len(X)
+    if validation_split and 0.0 < validation_split < 1.0:
+        split = max(int(n_rows * (1.0 - validation_split)), 1)
+        X_train, y_train = X[:split], y[:split]
+        X_val, y_val = X[split:], y[split:]
+    else:
+        X_train, y_train = X, y
+        X_val = y_val = None
+
+    n_samples = n_train_samples(spec, len(X_train))
+    if n_samples <= 0:
+        raise ValueError(
+            f"Not enough rows ({len(X_train)}) for lookback_window="
+            f"{spec.lookback_window} lookahead={spec.lookahead}"
+        )
+    batch_size = min(batch_size, max(n_samples, 1))
+    epoch_fn = _build_epoch_fn(spec, n_samples, batch_size, shuffle)
+
+    opt = make_optimizer(spec.optimizer)
+    opt_state = opt.init(params)
+
+    history: Dict[str, List[float]] = {"loss": []}
+    if X_val is not None:
+        history["val_loss"] = []
+
+    for cb in callbacks:
+        if hasattr(cb, "on_train_begin"):
+            cb.on_train_begin()
+
+    epochs_trained = 0
+    stop = False
+    for epoch in range(epochs):
+        rng, epoch_rng = jax.random.split(rng)
+        params, opt_state, loss = epoch_fn(params, opt_state, X_train, y_train, epoch_rng)
+        logs = {"loss": float(loss)}
+        if X_val is not None and len(X_val) > 0:
+            n_val = n_train_samples(spec, len(X_val))
+            if n_val > 0:
+                val_fn = _build_eval_fn(spec, n_val)
+                logs["val_loss"] = float(val_fn(params, X_val, y_val))
+        for key, value in logs.items():
+            history.setdefault(key, []).append(value)
+        epochs_trained = epoch + 1
+        for cb in callbacks:
+            if hasattr(cb, "on_epoch_end") and cb.on_epoch_end(epoch, logs, params):
+                stop = True
+        if stop:
+            break
+
+    for cb in callbacks:
+        if hasattr(cb, "on_train_end"):
+            restored = cb.on_train_end(params)
+            if restored is not None:
+                params = restored
+
+    return TrainResult(params=params, history=history, epochs_trained=epochs_trained)
+
+
+def predict_fn(spec: ModelSpec) -> Callable:
+    """
+    Return a cached, jitted predictor ``f(params, X) -> np.ndarray`` with
+    power-of-two shape bucketing so serving-time requests of varying length
+    hit a bounded set of compiled programs.
+    """
+    return _build_predictor(spec)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@functools.lru_cache(maxsize=256)
+def _build_predictor(spec: ModelSpec):
+    @functools.lru_cache(maxsize=32)
+    def padded_apply(n_pad: int):
+        if spec.lookback_window <= 1 and spec.lookahead == 0:
+
+            def run(params, X):
+                out, _ = apply_model(spec, params, X)
+                return out
+
+        else:
+
+            def run(params, X):
+                idx = jnp.arange(n_pad)
+                window = jnp.arange(spec.lookback_window)
+                xb = X[idx[:, None] + window[None, :]]
+                out, _ = apply_model(spec, params, xb)
+                return out
+
+        return jax.jit(run)
+
+    def predict(params, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        n_out = n_train_samples(spec, len(X))
+        if n_out <= 0:
+            raise ValueError(
+                f"Need at least {spec.lookback_window + spec.lookahead} rows, got {len(X)}"
+            )
+        if spec.lookback_window <= 1 and spec.lookahead == 0:
+            n_pad = _next_pow2(len(X))
+            X_pad = np.zeros((n_pad, X.shape[1]), np.float32)
+            X_pad[: len(X)] = X
+            out = padded_apply(n_pad)(params, jnp.asarray(X_pad))
+            return np.asarray(out[: len(X)])
+        else:
+            n_pad = _next_pow2(n_out)
+            # pad the flat series so every window start up to n_pad is valid;
+            # targets index up to n_pad-1 + lookback-1 + lookahead. Must also
+            # hold all of X itself.
+            rows_needed = max(
+                n_pad + spec.lookback_window - 1 + spec.lookahead, len(X)
+            )
+            X_pad = np.zeros((rows_needed, X.shape[1]), np.float32)
+            X_pad[: len(X)] = X
+            out = padded_apply(n_pad)(params, jnp.asarray(X_pad))
+            return np.asarray(out[:n_out])
+
+    return predict

@@ -1,0 +1,3 @@
+from .base import BaseReporter, ReporterException
+
+__all__ = ["BaseReporter", "ReporterException"]

@@ -1,0 +1,33 @@
+"""
+Reporter ABC: post-build metadata sinks.
+
+Reference parity: gordo/reporters/base.py:9-34 — serializer-based to/from
+dict so reporters can be declared in machine runtime config.
+"""
+
+import abc
+
+from gordo_tpu import serializer
+
+
+class ReporterException(Exception):
+    pass
+
+
+class BaseReporter(abc.ABC):
+    @abc.abstractmethod
+    def report(self, machine):
+        """Report the machine's metadata to the sink."""
+
+    def get_params(self, deep=False):
+        return dict(getattr(self, "_params", {}))
+
+    def to_dict(self):
+        return serializer.into_definition(self)
+
+    @classmethod
+    def from_dict(cls, config: dict):
+        obj = serializer.from_definition(config)
+        if not isinstance(obj, BaseReporter):
+            raise ReporterException(f"Expected a reporter, got {type(obj)}")
+        return obj

@@ -1,0 +1,167 @@
+"""
+Definition DSL → live object graph.
+
+Semantics match the reference (gordo/serializer/from_definition.py:20-296):
+a definition is a dict with a single import-path key mapping to kwargs;
+``Pipeline``/``FeatureUnion`` ``steps``/``transformer_list`` recurse; classes
+exposing a ``from_definition`` classmethod get the raw params dict; string
+param values resolving to callables are replaced by the callable; ``callbacks``
+lists are built recursively. Resolution goes through the allowlisting resolver
+instead of ``pydoc.locate``.
+"""
+
+import copy
+import logging
+from typing import Any, Dict, Iterable, Union
+
+from sklearn.base import BaseEstimator
+from sklearn.pipeline import FeatureUnion, Pipeline
+
+from .resolver import locate
+
+logger = logging.getLogger(__name__)
+
+
+def from_definition(
+    pipe_definition: Union[str, Dict[str, Dict[str, Any]]]
+) -> Union[FeatureUnion, Pipeline, BaseEstimator]:
+    """
+    Construct a live estimator/pipeline from a definition dict.
+
+    Example
+    -------
+    >>> import yaml
+    >>> from gordo_tpu import serializer
+    >>> raw = '''
+    ... sklearn.pipeline.Pipeline:
+    ...     steps:
+    ...         - sklearn.preprocessing.MinMaxScaler
+    ...         - gordo_tpu.models.models.AutoEncoder:
+    ...             kind: feedforward_hourglass
+    ... '''
+    >>> pipe = serializer.from_definition(yaml.safe_load(raw))
+    >>> type(pipe).__name__
+    'Pipeline'
+    """
+    definition = copy.deepcopy(pipe_definition)
+    return _build_step(definition)
+
+
+def _build_branch(definition: Iterable, constructor_class=None):
+    steps = [_build_step(step) for step in definition]
+    return steps if constructor_class is None else constructor_class(steps)
+
+
+def _build_scikit_branch(definition: Iterable, constructor_class=None):
+    steps = [(f"step_{i}", _build_step(step)) for i, step in enumerate(definition)]
+    return steps if constructor_class is None else constructor_class(steps)
+
+
+def _build_step(step: Union[str, Dict[str, Dict[str, Any]]]):
+    logger.debug("Building step: %s", step)
+
+    if isinstance(step, dict):
+        if len(step.keys()) != 1:
+            return _load_param_classes(step)
+
+        import_str = list(step.keys())[0]
+        StepClass = locate(import_str)
+        if StepClass is None:
+            raise ImportError(f'Could not locate path: "{import_str}"')
+
+        params = step.get(import_str, dict())
+
+        if hasattr(StepClass, "from_definition"):
+            return getattr(StepClass, "from_definition")(params)
+
+        if isinstance(params, dict):
+            params = _load_param_classes(params)
+            for param, value in params.items():
+                if isinstance(value, str):
+                    try:
+                        possible_func = locate(value)
+                    except ImportError:
+                        possible_func = None
+                    if callable(possible_func):
+                        params[param] = possible_func
+
+        if StepClass in (FeatureUnion, Pipeline):
+            if isinstance(params, dict) and "transformer_list" in params:
+                params["transformer_list"] = _build_scikit_branch(
+                    params["transformer_list"], None
+                )
+            elif isinstance(params, dict) and "steps" in params:
+                params["steps"] = _build_scikit_branch(params["steps"], None)
+            elif isinstance(params, (tuple, list)):
+                return StepClass(_build_scikit_branch(params, None))
+            else:
+                raise ValueError(
+                    f"Got {StepClass} but the supplied parameters seem invalid: {params}"
+                )
+        return StepClass(**params)
+
+    elif isinstance(step, str):
+        StepClass = locate(step)
+        if StepClass is None:
+            raise ImportError(f'Could not locate path: "{step}"')
+        if hasattr(StepClass, "from_definition"):
+            return getattr(StepClass, "from_definition")({})
+        return StepClass()
+
+    raise ValueError(f"Expected step to be str or dict, found: {type(step)}")
+
+
+def _build_callbacks(definitions: list) -> list:
+    """
+    Build training callbacks from definitions. Our training engine accepts
+    lightweight callback objects from ``gordo_tpu.models.callbacks`` (e.g.
+    ``EarlyStopping``); reference keras callback paths are aliased there.
+    """
+    return [_build_step(callback) for callback in definitions]
+
+
+def _load_param_classes(params: dict) -> dict:
+    """
+    Replace param values which reference classes (strings or single-key dicts)
+    by live instances. Mirrors gordo/serializer/from_definition.py:220-296.
+    """
+    params = copy.copy(params)
+    for key, value in params.items():
+        if isinstance(value, str):
+            try:
+                Model = locate(value)
+            except ImportError:
+                Model = None
+            if Model is not None:
+                if hasattr(Model, "from_definition"):
+                    params[key] = getattr(Model, "from_definition")({})
+                elif isinstance(Model, type) and issubclass(Model, BaseEstimator):
+                    params[key] = Model()
+        elif (
+            isinstance(value, dict)
+            and len(value.keys()) == 1
+            and isinstance(value[list(value.keys())[0]], dict)
+        ):
+            import_path = list(value.keys())[0]
+            try:
+                Model = locate(import_path)
+            except ImportError:
+                Model = None
+            sub_params = value[import_path]
+            if Model is not None and hasattr(Model, "from_definition"):
+                params[key] = getattr(Model, "from_definition")(sub_params)
+            elif Model is not None and isinstance(Model, type):
+                if issubclass(Model, Pipeline):
+                    params[key] = from_definition(value)
+                else:
+                    params[key] = Model(**_load_param_classes(sub_params))
+        elif key == "callbacks" and isinstance(value, list):
+            params[key] = _build_callbacks(value)
+    return params
+
+
+def load_params_from_definition(definition: dict) -> dict:
+    """Deserialize each value of a dict (e.g. fit-kwargs with callback specs)."""
+    if not isinstance(definition, dict):
+        raise ValueError(f"Expected definition to be a dict, found: {type(definition)}")
+    return _load_param_classes(definition)

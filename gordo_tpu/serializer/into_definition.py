@@ -1,0 +1,66 @@
+"""
+Live object graph → definition DSL (inverse of ``from_definition``).
+
+Semantics match the reference (gordo/serializer/into_definition.py:12-167):
+recursion via ``get_params(deep=False)``, ``into_definition`` hook wins when
+present, callables flatten to their import path, lists of (name, estimator)
+tuples decompose element-wise.
+"""
+
+import inspect
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def into_definition(pipeline, prune_default_params: bool = False) -> dict:
+    """
+    Convert a live pipeline/estimator into a primitives-only definition dict
+    reconstructable by :func:`gordo_tpu.serializer.from_definition`.
+    """
+    return _decompose_node(pipeline, prune_default_params)
+
+
+def _decompose_node(step: object, prune_default_params: bool = False) -> dict:
+    import_str = f"{step.__module__}.{step.__class__.__name__}"
+
+    if hasattr(step, "into_definition"):
+        definition = getattr(step, "into_definition")()
+    else:
+        params = getattr(step, "get_params")(deep=False)
+        definition = load_definition_from_params(params)
+        if prune_default_params:
+            definition = _prune_default_parameters(step, definition)
+    return {import_str: definition}
+
+
+def _prune_default_parameters(obj: object, current_params: dict) -> dict:
+    signature = inspect.signature(obj.__class__.__init__)
+    default_params = {
+        k: v.default
+        for k, v in signature.parameters.items()
+        if v.default is not inspect.Parameter.empty
+    }
+    return {
+        k: v
+        for (k, v) in current_params.items()
+        if k not in default_params or current_params[k] != default_params[k]
+    }
+
+
+def load_definition_from_params(params: dict) -> dict:
+    """Recursively decompose each param value into primitives."""
+    definition: dict = {}
+    for param, param_val in params.items():
+        if hasattr(param_val, "get_params") or hasattr(param_val, "into_definition"):
+            definition[param] = _decompose_node(param_val)
+        elif isinstance(param_val, list):
+            definition[param] = [
+                _decompose_node(leaf[1]) if isinstance(leaf, tuple) else leaf
+                for leaf in param_val
+            ]
+        elif callable(param_val):
+            definition[param] = f"{param_val.__module__}.{param_val.__name__}"
+        else:
+            definition[param] = param_val
+    return definition

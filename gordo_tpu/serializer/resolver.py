@@ -1,0 +1,103 @@
+"""
+Safe import-path resolution for the definition DSL.
+
+The DSL keys definitions by import path (``sklearn.pipeline.Pipeline``). The
+reference resolves these with ``pydoc.locate`` — effectively arbitrary code
+loading from config. Here resolution is restricted to an allowlist of module
+prefixes, plus an alias table translating reference (``gordo.*``) paths into
+their gordo_tpu equivalents so reference configs run unmodified
+(reference: gordo/serializer/from_definition.py:92-194).
+"""
+
+import importlib
+from typing import Any, Optional
+
+ALLOWED_PREFIXES = (
+    "sklearn.",
+    "gordo_tpu.",
+    "numpy.",
+    "scipy.",
+)
+
+# Reference-path compatibility aliases: old gordo import paths → ours.
+GORDO_COMPAT_ALIASES = {
+    "gordo.machine.model.models.KerasAutoEncoder": "gordo_tpu.models.models.AutoEncoder",
+    "gordo.machine.model.models.KerasLSTMAutoEncoder": "gordo_tpu.models.models.LSTMAutoEncoder",
+    "gordo.machine.model.models.KerasLSTMForecast": "gordo_tpu.models.models.LSTMForecast",
+    "gordo.machine.model.models.KerasRawModelRegressor": "gordo_tpu.models.models.RawModelRegressor",
+    "gordo.machine.model.anomaly.diff.DiffBasedAnomalyDetector": "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector",
+    "gordo.machine.model.anomaly.diff.DiffBasedKFCVAnomalyDetector": "gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector",
+    "gordo.machine.model.transformers.imputer.InfImputer": "gordo_tpu.models.transformers.imputer.InfImputer",
+    "gordo.machine.model.transformer_funcs.general.multiply_by": "gordo_tpu.models.transformer_funcs.general.multiply_by",
+    # keras callback paths from reference configs map onto our host-loop callbacks
+    "tensorflow.keras.callbacks.EarlyStopping": "gordo_tpu.models.callbacks.EarlyStopping",
+    "keras.callbacks.EarlyStopping": "gordo_tpu.models.callbacks.EarlyStopping",
+}
+# Short names also accepted (reference allows bare class names in some spots).
+SHORT_ALIASES = {
+    "AutoEncoder": "gordo_tpu.models.models.AutoEncoder",
+    "KerasAutoEncoder": "gordo_tpu.models.models.AutoEncoder",
+    "LSTMAutoEncoder": "gordo_tpu.models.models.LSTMAutoEncoder",
+    "KerasLSTMAutoEncoder": "gordo_tpu.models.models.LSTMAutoEncoder",
+    "LSTMForecast": "gordo_tpu.models.models.LSTMForecast",
+    "KerasLSTMForecast": "gordo_tpu.models.models.LSTMForecast",
+    "RawModelRegressor": "gordo_tpu.models.models.RawModelRegressor",
+    "KerasRawModelRegressor": "gordo_tpu.models.models.RawModelRegressor",
+    "DiffBasedAnomalyDetector": "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector",
+    "DiffBasedKFCVAnomalyDetector": "gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector",
+    "InfImputer": "gordo_tpu.models.transformers.imputer.InfImputer",
+    "MinMaxScaler": "sklearn.preprocessing.MinMaxScaler",
+    "RobustScaler": "sklearn.preprocessing.RobustScaler",
+    "StandardScaler": "sklearn.preprocessing.StandardScaler",
+    "Pipeline": "sklearn.pipeline.Pipeline",
+    "FeatureUnion": "sklearn.pipeline.FeatureUnion",
+    "FunctionTransformer": "sklearn.preprocessing.FunctionTransformer",
+    "PCA": "sklearn.decomposition.PCA",
+    "TimeSeriesSplit": "sklearn.model_selection.TimeSeriesSplit",
+    "KFold": "sklearn.model_selection.KFold",
+}
+
+
+class UnsafeImportError(ImportError):
+    """Raised when a definition references a non-allowlisted import path."""
+
+
+def canonical_path(path: str) -> str:
+    if path in GORDO_COMPAT_ALIASES:
+        return GORDO_COMPAT_ALIASES[path]
+    if path in SHORT_ALIASES:
+        return SHORT_ALIASES[path]
+    return path
+
+
+def locate(path: str) -> Optional[Any]:
+    """
+    Resolve a dotted path to a class/function, or None if it does not resolve.
+    Raises UnsafeImportError for paths outside the allowlist.
+    """
+    path = canonical_path(path)
+    if "." not in path:
+        return None
+    if not path.startswith(ALLOWED_PREFIXES):
+        raise UnsafeImportError(
+            f"Refusing to import {path!r}: module prefix not in allowlist "
+            f"{ALLOWED_PREFIXES}. Register your class under gordo_tpu.* or "
+            f"extend ALLOWED_PREFIXES deliberately."
+        )
+    module_path, _, name = path.rpartition(".")
+    while module_path:
+        try:
+            module = importlib.import_module(module_path)
+        except ImportError:
+            # the attribute chain may span nested attributes
+            parts = module_path.rpartition(".")
+            name = parts[2] + "." + name
+            module_path = parts[0]
+            continue
+        obj: Any = module
+        for attr in name.split("."):
+            obj = getattr(obj, attr, None)
+            if obj is None:
+                return None
+        return obj
+    return None

@@ -1,0 +1,65 @@
+"""
+Artifact persistence: dump/load a trained pipeline to/from a directory.
+
+Reference parity: gordo/serializer/serializer.py:22-170 — ``dump`` writes
+``model.pkl`` + ``metadata.json``; ``load`` reads them back; ``dumps/loads``
+are the raw-bytes forms used by the /download-model route.
+
+Our JAX estimators implement ``__getstate__``/``__setstate__`` so their
+parameter pytrees serialize as flax msgpack bytes inside the pickle (the
+TPU-native analog of the reference's h5-inside-pickle trick,
+gordo/machine/model/models.py:183-208). Pickle remains the envelope because
+arbitrary fitted sklearn preprocessing steps must round-trip too.
+"""
+
+import os
+import pickle
+from typing import Any, Optional, Union
+
+import simplejson
+
+
+def dumps(model: Any) -> bytes:
+    """Serialize a model/pipeline to bytes (loadable with :func:`loads`)."""
+    return pickle.dumps(model)
+
+
+def loads(bytes_object: bytes) -> Any:
+    """Load a model from bytes produced by :func:`dumps`."""
+    return pickle.loads(bytes_object)
+
+
+def metadata_path(source_dir: Union[os.PathLike, str]) -> Optional[str]:
+    """Locate metadata.json in ``source_dir`` or one directory above."""
+    possible_paths = [
+        os.path.join(source_dir, "metadata.json"),
+        os.path.join(source_dir, "..", "metadata.json"),
+    ]
+    return next((p for p in possible_paths if os.path.exists(p)), None)
+
+
+def load_metadata(source_dir: Union[os.PathLike, str]) -> dict:
+    """Load metadata.json saved next to a dumped model."""
+    path = metadata_path(source_dir)
+    if path is None:
+        raise FileNotFoundError(
+            f"Metadata file in source dir: '{source_dir}' not found in or up one directory."
+        )
+    with open(path, "r") as f:
+        return simplejson.load(f)
+
+
+def load(source_dir: Union[os.PathLike, str]) -> Any:
+    """Load a model dumped by :func:`dump`."""
+    with open(os.path.join(source_dir, "model.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+def dump(obj: object, dest_dir: Union[os.PathLike, str], metadata: dict = None):
+    """Serialize ``obj`` (and optional metadata) into ``dest_dir``."""
+    os.makedirs(dest_dir, exist_ok=True)
+    with open(os.path.join(dest_dir, "model.pkl"), "wb") as m:
+        pickle.dump(obj, m)
+    if metadata is not None:
+        with open(os.path.join(dest_dir, "metadata.json"), "w") as f:
+            simplejson.dump(metadata, f, default=str)

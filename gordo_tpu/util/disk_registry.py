@@ -1,0 +1,52 @@
+"""
+A simple file-per-key registry on disk.
+
+Used as the model build cache index: the builder maps a content hash of the
+machine config to the directory holding the trained artifact.
+
+Reference parity: gordo/util/disk_registry.py:18-117 (write_key / get_value /
+delete_value). Keys are sanitized the same way (logged, stored one file per
+key); concurrent writes of the same key are last-writer-wins.
+"""
+
+import logging
+import re
+from pathlib import Path
+from typing import AnyStr, Optional, Union
+
+logger = logging.getLogger(__name__)
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_.-]")
+
+
+def _key_path(registry_dir: Union[Path, str], key: str) -> Path:
+    safe = _INVALID.sub("_", key)
+    return Path(registry_dir) / safe
+
+
+def write_key(registry_dir: Union[Path, str], key: str, val: AnyStr):
+    """Register a key-value pair. Overwrites any existing value for the key."""
+    path = _key_path(registry_dir, key)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if path.exists():
+        logger.warning("Key %s already exists in registry %s; overwriting", key, registry_dir)
+    mode = "wb" if isinstance(val, bytes) else "w"
+    with path.open(mode) as f:
+        f.write(val)
+
+
+def get_value(registry_dir: Union[Path, str], key: str) -> Optional[str]:
+    """Return the value stored under ``key``, or None if absent."""
+    path = _key_path(registry_dir, key)
+    if not path.is_file():
+        return None
+    return path.read_text()
+
+
+def delete_value(registry_dir: Union[Path, str], key: str) -> bool:
+    """Delete the stored key; returns True if something was deleted."""
+    path = _key_path(registry_dir, key)
+    if path.is_file():
+        path.unlink()
+        return True
+    return False

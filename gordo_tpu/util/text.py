@@ -1,0 +1,10 @@
+"""Reference parity: gordo/util/text.py:3-7 (non-ASCII scrub)."""
+
+import re
+
+_non_ascii = re.compile(r"[^\x00-\x7F]")
+
+
+def replace_all_non_ascii_chars(s: str, replacement: str = "?") -> str:
+    """Replace all non-ASCII characters (k8s termination messages must be ASCII)."""
+    return _non_ascii.sub(replacement, s)

@@ -1,0 +1,30 @@
+"""
+Small shared utilities.
+
+Reference parity: gordo/util/utils.py:6-49 (capture_args).
+"""
+
+import functools
+import inspect
+
+
+def capture_args(method):
+    """
+    Decorator for ``__init__`` that records the call arguments into
+    ``self._params`` so objects can implement ``get_params`` cheaply
+    (used by reporters and other non-sklearn components).
+    """
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        sig = inspect.signature(method)
+        bound = sig.bind(self, *args, **kwargs)
+        bound.apply_defaults()
+        params = dict(bound.arguments)
+        params.pop("self", None)
+        if "kwargs" in params:
+            params.update(params.pop("kwargs"))
+        self._params = params
+        return method(self, *args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,152 @@
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu.models.anomaly.diff import (
+    DiffBasedAnomalyDetector,
+    DiffBasedKFCVAnomalyDetector,
+)
+from gordo_tpu.models.models import AutoEncoder
+
+
+@pytest.fixture(scope="module")
+def Xy_frames():
+    rng = np.random.RandomState(0)
+    index = pd.date_range("2019-01-01", periods=300, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        rng.rand(300, 3), columns=["t1", "t2", "t3"], index=index
+    )
+    return X, X.copy()
+
+
+def _detector(**kwargs):
+    return DiffBasedAnomalyDetector(
+        base_estimator=Pipeline(
+            [
+                ("mm", MinMaxScaler()),
+                ("ae", AutoEncoder(kind="feedforward_hourglass", epochs=1)),
+            ]
+        ),
+        **kwargs,
+    )
+
+
+def test_cross_validate_sets_thresholds(Xy_frames):
+    X, y = Xy_frames
+    det = _detector(require_thresholds=True)
+    cv_out = det.cross_validate(X=X, y=y)
+    assert "estimator" in cv_out
+    assert len(cv_out["estimator"]) == 3
+    assert det.feature_thresholds_ is not None
+    assert len(det.feature_thresholds_) == 3
+    assert isinstance(det.aggregate_threshold_, float)
+    assert set(det.aggregate_thresholds_per_fold_) == {"fold-0", "fold-1", "fold-2"}
+    assert det.feature_thresholds_per_fold_.shape[0] == 3
+
+
+def test_anomaly_requires_thresholds(Xy_frames):
+    X, y = Xy_frames
+    det = _detector(require_thresholds=True)
+    det.fit(X, y)
+    with pytest.raises(AttributeError):
+        det.anomaly(X, y)
+
+
+def test_anomaly_frame_schema(Xy_frames):
+    X, y = Xy_frames
+    det = _detector(require_thresholds=False)
+    det.cross_validate(X=X, y=y)
+    det.fit(X, y)
+    frame = det.anomaly(X, y, frequency=pd.Timedelta("10min"))
+    top = set(frame.columns.get_level_values(0))
+    assert {
+        "start",
+        "end",
+        "model-input",
+        "model-output",
+        "tag-anomaly-scaled",
+        "tag-anomaly-unscaled",
+        "total-anomaly-scaled",
+        "total-anomaly-unscaled",
+        "anomaly-confidence",
+        "total-anomaly-confidence",
+    } <= top
+    assert len(frame) == len(X)
+    # start column is isoformat strings
+    assert frame[("start", "")].iloc[0].startswith("2019-01-01")
+
+
+def test_anomaly_smoothed_columns(Xy_frames):
+    X, y = Xy_frames
+    det = _detector(require_thresholds=False, window=12, smoothing_method="sma")
+    det.cross_validate(X=X, y=y)
+    det.fit(X, y)
+    frame = det.anomaly(X, y)
+    top = set(frame.columns.get_level_values(0))
+    assert {
+        "smooth-tag-anomaly-scaled",
+        "smooth-total-anomaly-scaled",
+        "smooth-tag-anomaly-unscaled",
+        "smooth-total-anomaly-unscaled",
+    } <= top
+    # smoothed metadata recorded
+    md = det.get_metadata()
+    assert md["window"] == 12
+    assert md["smoothing-method"] == "sma"
+    assert "smooth-feature-thresholds" in md
+
+
+def test_default_smoothing_method_set():
+    det = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(kind="feedforward_hourglass"), window=10
+    )
+    assert det.smoothing_method == "smm"
+
+
+def test_get_metadata_thresholds(Xy_frames):
+    X, y = Xy_frames
+    det = _detector(require_thresholds=False)
+    det.cross_validate(X=X, y=y)
+    md = det.get_metadata()
+    assert "feature-thresholds" in md
+    assert "aggregate-threshold" in md
+    assert "feature-thresholds-per-fold" in md
+
+
+def test_kfcv_detector(Xy_frames):
+    X, y = Xy_frames
+    det = DiffBasedKFCVAnomalyDetector(
+        base_estimator=Pipeline(
+            [
+                ("mm", MinMaxScaler()),
+                ("ae", AutoEncoder(kind="feedforward_hourglass", epochs=1)),
+            ]
+        ),
+        require_thresholds=True,
+        window=24,
+        threshold_percentile=0.99,
+    )
+    det.cross_validate(X=X, y=y)
+    assert isinstance(det.aggregate_threshold_, float)
+    assert len(det.feature_thresholds_) == 3
+    det.fit(X, y)
+    frame = det.anomaly(X, y)
+    assert "total-anomaly-confidence" in frame.columns.get_level_values(0)
+
+
+def test_scoring_passthrough(Xy_frames):
+    X, y = Xy_frames
+    det = _detector(require_thresholds=False)
+    det.fit(X, y)
+    assert isinstance(det.score(X, y), float)
+
+
+def test_sklearn_clone_returns_detector():
+    from sklearn.base import clone
+
+    det = _detector(require_thresholds=False)
+    c = clone(det)
+    assert isinstance(c, DiffBasedAnomalyDetector)
+    assert isinstance(c.base_estimator, Pipeline)

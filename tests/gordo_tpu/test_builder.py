@@ -1,0 +1,149 @@
+import numpy as np
+import pytest
+import yaml
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import ModelBuilder, local_build
+from gordo_tpu.machine import Machine
+
+
+def machine_config(name="test-model", cv_mode="full_build", epochs=1):
+    return {
+        "name": name,
+        "dataset": {
+            "type": "RandomDataset",
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-05T00:00:00+00:00",
+            "tags": ["tag-0", "tag-1", "tag-2"],
+        },
+        "model": {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    "sklearn.preprocessing.MinMaxScaler",
+                    {
+                        "gordo_tpu.models.models.AutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "epochs": epochs,
+                        }
+                    },
+                ]
+            }
+        },
+        "evaluation": {"cv_mode": cv_mode},
+        "project_name": "test-project",
+    }
+
+
+@pytest.fixture(scope="module")
+def built():
+    machine = Machine.from_config(machine_config(), project_name="test-project")
+    return ModelBuilder(machine).build()
+
+
+def test_build_returns_fitted_model(built):
+    model, machine = built
+    assert hasattr(model, "predict")
+    out = model.predict(np.random.rand(10, 3))
+    assert out.shape == (10, 3)
+
+
+def test_build_metadata(built):
+    _, machine = built
+    md = machine.metadata.build_metadata
+    assert md.model.model_offset == 0
+    assert md.model.model_training_duration_sec > 0
+    assert md.dataset.query_duration_sec > 0
+    scores = md.model.cross_validation.scores
+    assert "r2-score" in scores
+    assert "r2-score-tag-0" in scores
+    assert set(scores["r2-score"]) >= {"fold-mean", "fold-1", "fold-2", "fold-3"}
+    splits = md.model.cross_validation.splits
+    assert "fold-1-train-start" in splits
+
+
+def test_cross_val_only_does_not_fit():
+    machine = Machine.from_config(
+        machine_config(cv_mode="cross_val_only"), project_name="test-project"
+    )
+    model, machine_out = ModelBuilder(machine).build()
+    # model not fitted on full data: AutoEncoder deep in pipeline lacks params_
+    ae = model.steps[-1][1]
+    assert not hasattr(ae, "params_")
+    assert machine_out.metadata.build_metadata.model.cross_validation.scores
+
+
+def test_cache_key_deterministic():
+    m1 = Machine.from_config(machine_config(), project_name="test-project")
+    m2 = Machine.from_config(machine_config(), project_name="test-project")
+    assert ModelBuilder(m1).cache_key == ModelBuilder(m2).cache_key
+    m3 = Machine.from_config(machine_config(name="other-model"), project_name="x")
+    assert ModelBuilder(m1).cache_key != ModelBuilder(m3).cache_key
+
+
+def test_build_cache_roundtrip(tmp_path):
+    machine = Machine.from_config(machine_config(), project_name="test-project")
+    out1 = tmp_path / "out1"
+    registry = tmp_path / "registry"
+    builder = ModelBuilder(machine)
+    model, machine_out = builder.build(output_dir=out1, model_register_dir=registry)
+    assert (out1 / "model.pkl").exists()
+    assert (out1 / "metadata.json").exists()
+
+    # second build hits the cache
+    out2 = tmp_path / "out2"
+    builder2 = ModelBuilder(machine)
+    assert builder2.check_cache(registry)
+    model2, machine_out2 = builder2.build(output_dir=out2, model_register_dir=registry)
+    user_defined = machine_out2.metadata.user_defined
+    assert user_defined.get("build-metadata", {}).get("from_cache") is True
+
+    # replace_cache busts it
+    model3, machine_out3 = ModelBuilder(machine).build(
+        output_dir=tmp_path / "out3", model_register_dir=registry, replace_cache=True
+    )
+    assert (
+        machine_out3.metadata.user_defined.get("build-metadata", {}).get("from_cache")
+        is not True
+    )
+
+
+def test_determine_offset():
+    class FakeModel:
+        def predict(self, X):
+            return X[5:]
+
+    assert ModelBuilder._determine_offset(FakeModel(), np.zeros((20, 2))) == 5
+
+
+def test_metrics_from_list_default():
+    funcs = ModelBuilder.metrics_from_list()
+    names = [f.__name__ for f in funcs]
+    assert "explained_variance_score" in names
+    assert "r2_score" in names
+
+
+def test_metrics_from_list_custom():
+    funcs = ModelBuilder.metrics_from_list(
+        ["sklearn.metrics.mean_absolute_error", "r2_score"]
+    )
+    assert funcs[0].__name__ == "mean_absolute_error"
+    assert funcs[1].__name__ == "r2_score"
+
+
+def test_local_build_yields_all(config_str):
+    results = list(local_build(config_str))
+    assert len(results) == 2
+    for model, machine in results:
+        assert hasattr(model, "anomaly")
+        assert machine.metadata.build_metadata.model.model_meta
+
+
+def test_seed_reproducibility():
+    cfg = machine_config()
+    cfg["evaluation"]["seed"] = 42
+    m1 = Machine.from_config(cfg, project_name="p")
+    model1, _ = ModelBuilder(m1).build()
+    m2 = Machine.from_config(cfg, project_name="p")
+    model2, _ = ModelBuilder(m2).build()
+    X = np.random.RandomState(0).rand(20, 3)
+    assert np.allclose(model1.predict(X), model2.predict(X))

@@ -1,0 +1,137 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.dataset import (
+    GordoBaseDataset,
+    InsufficientDataError,
+    RandomDataProvider,
+    SensorTag,
+    TimeSeriesDataset,
+    normalize_sensor_tag,
+    normalize_sensor_tags,
+)
+
+
+def test_normalize_sensor_tag_forms():
+    assert normalize_sensor_tag("TAG-1") == SensorTag("TAG-1", None)
+    assert normalize_sensor_tag("TAG-1", asset="a") == SensorTag("TAG-1", "a")
+    assert normalize_sensor_tag({"name": "T", "asset": "a"}) == SensorTag("T", "a")
+    assert normalize_sensor_tag(["T", "a"]) == SensorTag("T", "a")
+    assert normalize_sensor_tag(SensorTag("T", "a")) == SensorTag("T", "a")
+    with pytest.raises(ValueError):
+        normalize_sensor_tag({"noname": 1})
+
+
+def test_random_provider_deterministic():
+    from datetime import datetime, timezone
+
+    provider = RandomDataProvider()
+    tags = normalize_sensor_tags(["tag-a", "tag-b"])
+    start = datetime(2019, 1, 1, tzinfo=timezone.utc)
+    end = datetime(2019, 1, 2, tzinfo=timezone.utc)
+    series1 = list(provider.load_series(start, end, tags))
+    series2 = list(provider.load_series(start, end, tags))
+    assert len(series1) == 2
+    assert len(series1[0]) == 144  # one day at 10min
+    pd.testing.assert_series_equal(series1[0], series2[0])
+    # distinct tags get distinct data
+    assert not np.allclose(series1[0].values, series1[1].values)
+
+
+def test_timeseries_dataset_get_data():
+    ds = TimeSeriesDataset(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-03T00:00:00+00:00",
+        tags=["tag-a", "tag-b"],
+        data_provider={"type": "RandomDataProvider"},
+    )
+    X, y = ds.get_data()
+    assert list(X.columns) == ["tag-a", "tag-b"]
+    assert X.shape == y.shape
+    assert len(X) == 288
+    meta = ds.get_metadata()
+    assert meta["resolution"] == "10min"
+    assert "query_duration_sec" in meta
+
+
+def test_dataset_from_dict_and_roundtrip():
+    config = {
+        "type": "RandomDataset",
+        "train_start_date": "2019-01-01T00:00:00+00:00",
+        "train_end_date": "2019-01-02T00:00:00+00:00",
+        "tags": ["tag-a"],
+    }
+    ds = GordoBaseDataset.from_dict(config)
+    d = ds.to_dict()
+    assert d["type"] == "RandomDataset"
+    ds2 = GordoBaseDataset.from_dict(d)
+    X1, _ = ds.get_data()
+    X2, _ = ds2.get_data()
+    pd.testing.assert_frame_equal(X1, X2)
+
+
+def test_target_tags_differ():
+    ds = TimeSeriesDataset(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-02T00:00:00+00:00",
+        tags=["tag-a", "tag-b"],
+        target_tag_list=["tag-c"],
+    )
+    X, y = ds.get_data()
+    assert list(X.columns) == ["tag-a", "tag-b"]
+    assert list(y.columns) == ["tag-c"]
+
+
+def test_insufficient_data_raises():
+    ds = TimeSeriesDataset(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-01T01:00:00+00:00",
+        tags=["tag-a"],
+        n_samples_threshold=10,
+    )
+    with pytest.raises(InsufficientDataError):
+        ds.get_data()
+
+
+def test_tz_naive_dates_rejected():
+    with pytest.raises(ValueError):
+        TimeSeriesDataset(
+            train_start_date="2019-01-01",
+            train_end_date="2019-01-02",
+            tags=["tag-a"],
+        )
+
+
+def test_start_after_end_rejected():
+    with pytest.raises(ValueError):
+        TimeSeriesDataset(
+            train_start_date="2019-01-02T00:00:00+00:00",
+            train_end_date="2019-01-01T00:00:00+00:00",
+            tags=["tag-a"],
+        )
+
+
+def test_multi_aggregation_methods():
+    ds = TimeSeriesDataset(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-02T00:00:00+00:00",
+        tags=["tag-a", "tag-b"],
+        aggregation_methods=["mean", "max"],
+    )
+    X, y = ds.get_data()
+    assert list(X.columns) == ["tag-a_mean", "tag-a_max", "tag-b_mean", "tag-b_max"]
+    assert (X["tag-a_max"] >= X["tag-a_mean"] - 1e-9).all()
+
+
+def test_to_dict_preserves_interpolation():
+    ds = TimeSeriesDataset(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-02T00:00:00+00:00",
+        tags=["tag-a"],
+        interpolation_limit="48h",
+    )
+    d = ds.to_dict()
+    assert d["interpolation_limit"] == "48h"
+    ds2 = GordoBaseDataset.from_dict(d)
+    assert ds2.interpolation_limit == "48h"

@@ -1,0 +1,94 @@
+import pytest
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.validators import ValidUrlString, fix_resource_limits
+from gordo_tpu.workflow.helpers import patch_dict
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+
+def base_config(name="machine-1"):
+    return {
+        "name": name,
+        "dataset": {
+            "type": "RandomDataset",
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-02T00:00:00+00:00",
+            "tags": ["tag-0"],
+        },
+        "model": {
+            "gordo_tpu.models.models.AutoEncoder": {"kind": "feedforward_hourglass"}
+        },
+    }
+
+
+def test_machine_from_config_roundtrip():
+    machine = Machine.from_config(base_config(), project_name="proj")
+    d = machine.to_dict()
+    machine2 = Machine.from_dict(d)
+    assert machine == machine2
+    assert machine.host == "gordoserver-proj-machine-1"
+
+
+def test_invalid_name_rejected():
+    cfg = base_config(name="Invalid_Name")
+    with pytest.raises(ValueError):
+        Machine.from_config(cfg, project_name="proj")
+
+
+def test_invalid_model_rejected():
+    cfg = base_config()
+    cfg["model"] = {"not.a.real.Thing": {}}
+    with pytest.raises(ValueError):
+        Machine.from_config(cfg, project_name="proj")
+
+
+def test_globals_patching():
+    cfg = base_config()
+    config_globals = {
+        "evaluation": {"cv_mode": "cross_val_only"},
+        "runtime": {"builder": {"resources": {"requests": {"memory": 100}}}},
+        "metadata": {"source": "global"},
+    }
+    machine = Machine.from_config(cfg, "proj", config_globals=config_globals)
+    assert machine.evaluation["cv_mode"] == "cross_val_only"
+    assert machine.metadata.user_defined["global-metadata"] == {"source": "global"}
+    # machine-level evaluation overrides globals
+    cfg2 = base_config()
+    cfg2["evaluation"] = {"cv_mode": "full_build"}
+    machine2 = Machine.from_config(cfg2, "proj", config_globals=config_globals)
+    assert machine2.evaluation["cv_mode"] == "full_build"
+
+
+def test_valid_url_string():
+    assert ValidUrlString.valid_url_string("abc-123")
+    assert not ValidUrlString.valid_url_string("Abc")
+    assert not ValidUrlString.valid_url_string("a" * 64)
+    assert not ValidUrlString.valid_url_string("-abc")
+
+
+def test_fix_resource_limits():
+    fixed = fix_resource_limits(
+        {"requests": {"memory": 10}, "limits": {"memory": 5}}
+    )
+    assert fixed["requests"]["memory"] == 5
+    fixed2 = fix_resource_limits({"requests": {"cpu": 1}, "limits": {"cpu": 4}})
+    assert fixed2["requests"]["cpu"] == 1
+
+
+def test_patch_dict_does_not_mutate():
+    original = {"a": {"b": 1}}
+    patched = patch_dict(original, {"a": {"c": 2}})
+    assert original == {"a": {"b": 1}}
+    assert patched == {"a": {"b": 1, "c": 2}}
+
+
+def test_normalized_config_defaults(config_str):
+    import yaml
+
+    config = yaml.safe_load(config_str)
+    norm = NormalizedConfig(config, project_name="proj")
+    assert len(norm.machines) == 2
+    machine = norm.machines[0]
+    assert machine.evaluation["cv_mode"] == "full_build"
+    assert machine.evaluation["scoring_scaler"] == "sklearn.preprocessing.MinMaxScaler"
+    assert "builder" in machine.runtime

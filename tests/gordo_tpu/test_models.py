@@ -1,0 +1,199 @@
+import pickle
+
+import numpy as np
+import pytest
+import yaml
+
+from gordo_tpu.models.factories.utils import hourglass_calc_dims
+from gordo_tpu.models.factories.feedforward_autoencoder import feedforward_hourglass
+from gordo_tpu.models.models import (
+    AutoEncoder,
+    LSTMAutoEncoder,
+    LSTMForecast,
+    RawModelRegressor,
+)
+from gordo_tpu.models.register import register_model_builder
+
+
+@pytest.fixture(scope="module")
+def Xy():
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 4).astype(np.float32)
+    return X, X
+
+
+def test_hourglass_dims_reference_examples():
+    """Dims match the reference factory's documented examples
+    (feedforward_autoencoder.py:165-257 docstring)."""
+    assert [l.units for l in feedforward_hourglass(10).layers] == [8, 7, 5, 5, 7, 8, 10]
+    assert [l.units for l in feedforward_hourglass(5).layers] == [4, 4, 3, 3, 4, 4, 5]
+    assert [
+        l.units for l in feedforward_hourglass(10, compression_factor=0.2).layers
+    ] == [7, 5, 2, 2, 5, 7, 10]
+    assert [l.units for l in feedforward_hourglass(10, encoding_layers=1).layers] == [
+        5,
+        5,
+        10,
+    ]
+
+
+def test_hourglass_validations():
+    with pytest.raises(ValueError):
+        hourglass_calc_dims(1.5, 3, 10)
+    with pytest.raises(ValueError):
+        hourglass_calc_dims(0.5, 0, 10)
+
+
+def test_autoencoder_fit_predict_score(Xy):
+    X, y = Xy
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=2, batch_size=64)
+    model.fit(X, y)
+    out = model.predict(X)
+    assert out.shape == X.shape
+    assert isinstance(model.score(X, y), float)
+    assert len(model.history["loss"]) == 2
+    # training reduces loss
+    assert model.history["loss"][-1] <= model.history["loss"][0] * 1.5
+
+
+def test_autoencoder_invalid_kind():
+    with pytest.raises(ValueError):
+        AutoEncoder(kind="no_such_factory")
+
+
+def test_autoencoder_pickle_roundtrip(Xy):
+    X, y = Xy
+    model = AutoEncoder(kind="feedforward_symmetric", dims=(8, 4), funcs=("tanh", "tanh"), epochs=1)
+    model.fit(X, y)
+    out = model.predict(X)
+    model2 = pickle.loads(pickle.dumps(model))
+    assert np.allclose(model2.predict(X), out, atol=1e-5)
+    assert model2.history["loss"] == model.history["loss"]
+
+
+def test_sklearn_clone_compat():
+    from sklearn.base import clone
+
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=3)
+    cloned = clone(model)
+    assert isinstance(cloned, AutoEncoder)
+    assert cloned.kind == "feedforward_hourglass"
+    assert cloned.kwargs["epochs"] == 3
+
+
+def test_seed_determinism(Xy):
+    X, y = Xy
+    np.random.seed(0)
+    m1 = AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    m1.fit(X, y)
+    np.random.seed(0)
+    m2 = AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    m2.fit(X, y)
+    assert np.allclose(m1.predict(X), m2.predict(X))
+
+
+def test_custom_callable_kind(Xy):
+    X, y = Xy
+
+    def my_model(n_features, n_features_out=None, **kwargs):
+        return feedforward_hourglass(n_features, n_features_out, encoding_layers=1)
+
+    model = AutoEncoder(kind=my_model, epochs=1)
+    assert model.kind == "my_model"
+    assert "my_model" in register_model_builder.factories["AutoEncoder"]
+    model.fit(X, y)
+    assert model.predict(X).shape == X.shape
+
+
+@pytest.mark.parametrize(
+    "cls,lookahead", [(LSTMAutoEncoder, 0), (LSTMForecast, 1)]
+)
+def test_lstm_window_semantics(cls, lookahead):
+    """Output length = len(X) - lookback + 1 - lookahead (reference
+    models.py:715-796 timeseries generator semantics)."""
+    rng = np.random.RandomState(1)
+    X = rng.rand(120, 3).astype(np.float32)
+    model = cls(kind="lstm_hourglass", lookback_window=12, epochs=1, batch_size=32)
+    model.fit(X, X)
+    out = model.predict(X)
+    assert out.shape == (120 - 12 + 1 - lookahead, 3)
+    assert model.lookahead == lookahead
+    score = model.score(X, X)
+    assert isinstance(score, float)
+
+
+def test_raw_model_regressor():
+    config = yaml.safe_load(
+        """
+        compile:
+          loss: mse
+          optimizer: adam
+        spec:
+          layers:
+            - Dense:
+                units: 8
+                activation: tanh
+            - Dense:
+                units: 2
+        """
+    )
+    rng = np.random.RandomState(2)
+    X = rng.rand(64, 4).astype(np.float32)
+    y = rng.rand(64, 2).astype(np.float32)
+    model = RawModelRegressor(kind=config, epochs=1)
+    model.fit(X, y)
+    assert model.predict(X).shape == (64, 2)
+
+
+def test_early_stopping_callback(Xy):
+    X, y = Xy
+    from gordo_tpu.models.callbacks import EarlyStopping
+
+    # min_delta=10 means no epoch ever counts as an improvement after the
+    # first, so patience=2 stops training at epoch 3
+    model = AutoEncoder(
+        kind="feedforward_hourglass",
+        epochs=50,
+        callbacks=[EarlyStopping(monitor="loss", patience=2, min_delta=10.0)],
+    )
+    model.fit(X, y)
+    assert len(model.history["loss"]) == 3
+
+
+def test_validation_split(Xy):
+    X, y = Xy
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=2, validation_split=0.2)
+    model.fit(X, y)
+    assert "val_loss" in model.history
+    assert len(model.history["val_loss"]) == 2
+
+
+def test_lstm_predict_pow2_boundary():
+    """Regression: windowed predict when n_out is a power of two must not
+    under-allocate the padded series (lookahead >= 1 case)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(11, 2).astype(np.float32)
+    model = LSTMForecast(kind="lstm_hourglass", lookback_window=3, epochs=1)
+    model.fit(X, X)
+    out = model.predict(X)
+    assert out.shape == (11 - 3 + 1 - 1, 2)
+
+
+def test_keras_callback_path_alias(Xy):
+    """Reference configs with tensorflow.keras callback paths still work."""
+    import yaml
+    from gordo_tpu.serializer import from_definition
+
+    X, y = Xy
+    model = from_definition(yaml.safe_load("""
+    gordo_tpu.models.models.AutoEncoder:
+      kind: feedforward_hourglass
+      epochs: 4
+      callbacks:
+        - tensorflow.keras.callbacks.EarlyStopping:
+            monitor: loss
+            patience: 1
+            min_delta: 100.0
+    """))
+    model.fit(X, y)
+    assert len(model.history["loss"]) == 2

@@ -1,0 +1,118 @@
+import numpy as np
+import pytest
+import yaml
+from sklearn.decomposition import PCA
+from sklearn.pipeline import FeatureUnion, Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu import serializer
+from gordo_tpu.models.models import AutoEncoder
+from gordo_tpu.serializer.resolver import UnsafeImportError, locate
+
+
+def test_from_definition_basic_pipeline():
+    definition = yaml.safe_load(
+        """
+        sklearn.pipeline.Pipeline:
+          steps:
+            - sklearn.preprocessing.MinMaxScaler
+            - gordo_tpu.models.models.AutoEncoder:
+                kind: feedforward_hourglass
+        """
+    )
+    pipe = serializer.from_definition(definition)
+    assert isinstance(pipe, Pipeline)
+    assert isinstance(pipe.steps[0][1], MinMaxScaler)
+    assert isinstance(pipe.steps[1][1], AutoEncoder)
+    assert pipe.steps[1][1].kind == "feedforward_hourglass"
+
+
+def test_from_definition_feature_union():
+    definition = yaml.safe_load(
+        """
+        sklearn.pipeline.FeatureUnion:
+          - sklearn.decomposition.PCA:
+              n_components: 2
+          - sklearn.preprocessing.MinMaxScaler
+        """
+    )
+    union = serializer.from_definition(definition)
+    assert isinstance(union, FeatureUnion)
+    assert isinstance(union.transformer_list[0][1], PCA)
+
+
+def test_gordo_compat_alias():
+    """Reference gordo configs resolve to gordo_tpu classes unmodified."""
+    definition = yaml.safe_load(
+        """
+        gordo.machine.model.anomaly.diff.DiffBasedAnomalyDetector:
+          require_thresholds: false
+          base_estimator:
+            gordo.machine.model.models.KerasAutoEncoder:
+              kind: feedforward_hourglass
+        """
+    )
+    from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+
+    det = serializer.from_definition(definition)
+    assert isinstance(det, DiffBasedAnomalyDetector)
+    assert isinstance(det.base_estimator, AutoEncoder)
+
+
+def test_into_definition_roundtrip():
+    pipe = Pipeline(
+        [
+            ("step_0", MinMaxScaler()),
+            ("step_1", AutoEncoder(kind="feedforward_hourglass", epochs=2)),
+        ]
+    )
+    definition = serializer.into_definition(pipe)
+    pipe2 = serializer.from_definition(definition)
+    definition2 = serializer.into_definition(pipe2)
+    assert definition == definition2
+    assert isinstance(pipe2.steps[1][1], AutoEncoder)
+    assert pipe2.steps[1][1].kwargs["epochs"] == 2
+
+
+def test_function_transformer_roundtrip():
+    definition = yaml.safe_load(
+        """
+        sklearn.preprocessing.FunctionTransformer:
+          func: gordo_tpu.models.transformer_funcs.general.multiply_by
+          kw_args:
+            factor: 2
+        """
+    )
+    ft = serializer.from_definition(definition)
+    assert np.allclose(ft.transform(np.array([1.0, 2.0])), [2.0, 4.0])
+    definition2 = serializer.into_definition(ft)
+    key = "sklearn.preprocessing._function_transformer.FunctionTransformer"
+    assert (
+        definition2[key]["func"]
+        == "gordo_tpu.models.transformer_funcs.general.multiply_by"
+    )
+
+
+def test_unsafe_import_rejected():
+    with pytest.raises(UnsafeImportError):
+        locate("os.system")
+    with pytest.raises((UnsafeImportError, ImportError)):
+        serializer.from_definition({"subprocess.Popen": {"args": ["ls"]}})
+
+
+def test_dump_load_roundtrip(tmp_path):
+    pipe = Pipeline([("mm", MinMaxScaler())])
+    X = np.random.rand(10, 2)
+    pipe.fit(X)
+    serializer.dump(pipe, tmp_path, metadata={"foo": "bar"})
+    pipe2 = serializer.load(tmp_path)
+    assert np.allclose(pipe2.transform(X), pipe.transform(X))
+    assert serializer.load_metadata(tmp_path) == {"foo": "bar"}
+
+
+def test_dumps_loads_roundtrip():
+    model = AutoEncoder(kind="feedforward_symmetric")
+    blob = serializer.dumps(model)
+    model2 = serializer.loads(blob)
+    assert isinstance(model2, AutoEncoder)
+    assert model2.kind == "feedforward_symmetric"
