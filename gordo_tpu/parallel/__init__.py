@@ -1,0 +1,17 @@
+"""
+gordo_tpu.parallel: multi-model fan-out on a device mesh.
+
+This subpackage is the TPU-native replacement for the reference's entire
+distributed runtime (SURVEY.md §2 'Parallelism strategies'): where gordo
+renders one Kubernetes pod per machine into an Argo DAG
+(argo-workflow.yml.template:1511-1525), gordo_tpu stacks homogeneous machines
+into a leading array axis, ``vmap``s the fused training program over that
+axis, and shards it across a ``jax.sharding.Mesh`` — N machines train in ONE
+XLA program with zero inter-machine communication (embarrassingly-parallel
+SPMD; collectives only appear in the multi-host data path).
+"""
+
+from .mesh import default_mesh, machines_sharding
+from .batch_trainer import BatchedModelBuilder
+
+__all__ = ["default_mesh", "machines_sharding", "BatchedModelBuilder"]

@@ -1,0 +1,32 @@
+"""
+Device-mesh construction and shardings.
+
+The canonical mesh for multi-model training is 1-D over all chips with axis
+``machines``; stacked per-machine arrays (params, data, rngs) shard along
+that axis so each chip trains its shard of machines with no collectives.
+Multi-host: the same Mesh spans hosts via jax.distributed — XLA handles
+ICI/DCN placement.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def default_mesh(
+    axis_name: str = "machines", devices: Optional[Sequence] = None
+) -> Mesh:
+    """A 1-D mesh over all (or the given) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def machines_sharding(mesh: Mesh, axis_name: str = "machines") -> NamedSharding:
+    """Shard the leading (machine) axis across the mesh; replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,179 @@
+import numpy as np
+import pandas as pd
+import pytest
+import yaml
+
+from gordo_tpu.parallel import BatchedModelBuilder, default_mesh
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+
+def _machine_block(name, n_tags=4, epochs=1, model=None):
+    tags = "".join(f"\n      - {name}-tag-{j}" for j in range(n_tags))
+    model = model or f"""
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        require_thresholds: true
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+            - sklearn.preprocessing.MinMaxScaler
+            - gordo_tpu.models.models.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: {epochs}"""
+    return f"""
+  - name: {name}
+    dataset:
+      tags:{tags}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+      data_provider: {{type: RandomDataProvider}}
+    model:{model}
+"""
+
+
+def _machines(config_yaml):
+    return NormalizedConfig(yaml.safe_load(config_yaml), project_name="pp").machines
+
+
+def test_mesh_has_8_virtual_devices():
+    mesh = default_mesh()
+    assert int(np.prod(list(mesh.shape.values()))) == 8
+
+
+@pytest.fixture(scope="module")
+def batch_results():
+    cfg = "machines:" + "".join(_machine_block(f"bm-{i}") for i in range(3))
+    machines = _machines(cfg)
+    return machines, BatchedModelBuilder(machines).build()
+
+
+def test_batched_build_returns_in_order(batch_results):
+    machines, results = batch_results
+    assert len(results) == 3
+    for machine, (model, machine_out) in zip(machines, results):
+        assert machine_out.name == machine.name
+
+
+def test_batched_artifacts_match_serial_api(batch_results):
+    _, results = batch_results
+    model, machine_out = results[0]
+    md = machine_out.to_dict()["metadata"]["build_metadata"]["model"]
+    # same metadata surface as the serial ModelBuilder
+    assert md["model_offset"] == 0
+    assert "aggregate-threshold" in md["model_meta"]
+    assert "feature-thresholds" in md["model_meta"]
+    scores = md["cross_validation"]["scores"]
+    assert "r2-score" in scores
+    assert {"fold-mean", "fold-std", "fold-1", "fold-2", "fold-3"} <= set(
+        scores["r2-score"]
+    )
+    splits = md["cross_validation"]["splits"]
+    assert "fold-1-train-start" in splits
+    for entry in scores.values():
+        assert all(np.isfinite(v) for v in entry.values())
+
+
+def test_batched_model_scores_anomalies(batch_results):
+    machines, results = batch_results
+    model, _ = results[1]
+    cols = [t.name for t in machines[1].dataset.tag_list]
+    idx = pd.date_range("2020-01-01", periods=20, freq="10min", tz="UTC")
+    X = pd.DataFrame(np.random.rand(20, 4), columns=cols, index=idx)
+    frame = model.anomaly(X, X, frequency=pd.Timedelta("10min"))
+    assert "total-anomaly-confidence" in frame.columns.get_level_values(0)
+    assert len(frame) == 20
+
+
+def test_heterogeneous_buckets_and_fallback():
+    cfg = "machines:" + (
+        _machine_block("small-0", n_tags=2)
+        + _machine_block("small-1", n_tags=2)
+        + _machine_block("wide-0", n_tags=6)
+        + _machine_block(
+            "plain-sklearn",
+            n_tags=2,
+            model="""
+      sklearn.pipeline.Pipeline:
+        steps:
+        - sklearn.preprocessing.MinMaxScaler
+        - sklearn.linear_model.LinearRegression
+""",
+        )
+    )
+    machines = _machines(cfg)
+    results = BatchedModelBuilder(machines).build()
+    assert len(results) == 4
+    # 2-tag and 6-tag machines end up in different buckets but both train
+    m_small, _ = results[0]
+    m_wide, _ = results[2]
+    assert m_small.base_estimator.steps[1][1].spec_.n_features == 2
+    assert m_wide.base_estimator.steps[1][1].spec_.n_features == 6
+    # sklearn model went through the serial fallback and is fitted
+    m_sk, machine_sk = results[3]
+    X = np.random.rand(5, 2)
+    assert m_sk.predict(X).shape[0] == 5
+
+
+def test_batched_seed_determinism():
+    cfg = "machines:" + _machine_block("det-0")
+    machines1 = _machines(cfg)
+    r1 = BatchedModelBuilder(machines1).build()
+    machines2 = _machines(cfg)
+    r2 = BatchedModelBuilder(machines2).build()
+    cols = [t.name for t in machines1[0].dataset.tag_list]
+    X = pd.DataFrame(np.random.RandomState(0).rand(16, 4), columns=cols)
+    out1 = r1[0][0].predict(X)
+    out2 = r2[0][0].predict(X)
+    assert np.allclose(out1, out2)
+
+
+def test_serial_fallback_disabled_raises():
+    cfg = "machines:" + _machine_block(
+        "nofall",
+        n_tags=2,
+        model="""
+      sklearn.pipeline.Pipeline:
+        steps:
+        - sklearn.preprocessing.MinMaxScaler
+        - sklearn.linear_model.LinearRegression
+""",
+    )
+    machines = _machines(cfg)
+    with pytest.raises(ValueError):
+        BatchedModelBuilder(machines, serial_fallback=False).build()
+
+
+def test_seed_independent_of_bucket_composition():
+    """A machine's weights must not depend on which machines share its bucket."""
+    solo = _machines("machines:" + _machine_block("indep-a"))
+    r_solo = BatchedModelBuilder(solo).build()
+    pair = _machines(
+        "machines:" + _machine_block("indep-b") + _machine_block("indep-a")
+    )
+    r_pair = BatchedModelBuilder(pair).build()
+    cols = [t.name for t in solo[0].dataset.tag_list]
+    X = pd.DataFrame(np.random.RandomState(1).rand(16, 4), columns=cols)
+    out_solo = r_solo[0][0].predict(X)
+    out_pair = r_pair[1][0].predict(X)  # indep-a is second in the pair config
+    assert np.allclose(out_solo, out_pair)
+
+
+def test_cross_val_only_goes_serial():
+    cfg = "machines:" + _machine_block("cvonly")
+    machines = _machines(cfg)
+    machines[0].evaluation["cv_mode"] = "cross_val_only"
+    results = BatchedModelBuilder(machines).build()
+    model, machine_out = results[0]
+    # serial cross_val_only contract: inner estimator not fitted
+    ae = model.base_estimator.steps[-1][1]
+    assert not hasattr(ae, "params_")
+    assert machine_out.metadata.build_metadata.model.cross_validation.scores
+
+
+def test_unsupported_metric_goes_serial():
+    cfg = "machines:" + _machine_block("oddmetric")
+    machines = _machines(cfg)
+    machines[0].evaluation["metrics"] = ["sklearn.metrics.max_error"]
+    results = BatchedModelBuilder(machines).build()
+    _, machine_out = results[0]
+    scores = machine_out.metadata.build_metadata.model.cross_validation.scores
+    assert any("max-error" in k for k in scores)
