@@ -1,0 +1,274 @@
+"""
+The gordo-tpu CLI.
+
+Reference parity: gordo/cli/cli.py:53-384 — ``build`` (env-var driven for
+workers: MACHINE, OUTPUT_DIR, MODEL_REGISTER_DIR; jinja --model-parameter
+expansion; full model-config expansion round-trip; stable exception exit
+codes; katib-format CV score printing) and ``run-server``.
+
+New TPU-native addition: ``batch-build`` trains a whole multi-machine config
+in one process on the device mesh (gordo_tpu.parallel) — the in-process
+replacement for the reference's one-pod-per-machine fan-out.
+
+Fault injection: the reference hard-codes a failure for machines whose name
+contains "err" (cli.py:179-180 — a test hook in production code). Here fault
+injection is explicit: set ``GORDO_TPU_FAULT_INJECTION=<ExceptionName>`` to
+raise after a successful build (used to exercise exit-code plumbing e2e).
+"""
+
+import logging
+import os
+import sys
+import traceback
+from typing import Any, List, Tuple
+
+import click
+import jinja2
+import yaml
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.builder import ModelBuilder
+from gordo_tpu.dataset.datasets import InsufficientDataError
+from gordo_tpu.dataset.sensor_tag import SensorTagNormalizationError
+from gordo_tpu.machine import Machine
+from gordo_tpu.reporters.base import ReporterException
+from .custom_types import HostIP, key_value_par
+from .exceptions_reporter import ExceptionsReporter, ReportLevel
+
+logger = logging.getLogger(__name__)
+
+_exceptions_reporter = ExceptionsReporter(
+    (
+        (Exception, 1),
+        (PermissionError, 20),
+        (FileNotFoundError, 30),
+        (SensorTagNormalizationError, 60),
+        (InsufficientDataError, 80),
+        (ReporterException, 90),
+    )
+)
+
+FAULT_INJECTION_ENV = "GORDO_TPU_FAULT_INJECTION"
+_INJECTABLE_FAULTS = {
+    "FileNotFoundError": FileNotFoundError,
+    "PermissionError": PermissionError,
+    "InsufficientDataError": InsufficientDataError,
+    "Exception": Exception,
+}
+
+
+@click.group("gordo-tpu")
+@click.version_option(version=__version__, message=__version__)
+@click.option(
+    "--log-level",
+    type=str,
+    default="INFO",
+    envvar="GORDO_LOG_LEVEL",
+    help="Run with custom log-level.",
+)
+@click.pass_context
+def gordo(gordo_ctx: click.Context, **ctx):
+    """The main entry point for the CLI interface."""
+    logging.basicConfig(
+        level=getattr(logging, str(gordo_ctx.params.get("log_level")).upper()),
+        format="[%(asctime)s] %(levelname)s [%(name)s.%(funcName)s:%(lineno)d] %(message)s",
+    )
+    gordo_ctx.obj = gordo_ctx.params
+
+
+def expand_model(model_config: str, model_parameters: dict):
+    """Render the jinja-templated model config with the given parameters."""
+    try:
+        model_template = jinja2.Environment(
+            loader=jinja2.BaseLoader(), undefined=jinja2.StrictUndefined
+        ).from_string(model_config)
+        model_config = model_template.render(**model_parameters)
+    except jinja2.exceptions.UndefinedError as e:
+        raise ValueError("Model parameter missing value!") from e
+    return yaml.safe_load(model_config)
+
+
+def get_all_score_strings(machine) -> List[str]:
+    """Katib-format '{metric}_{fold}={value}' lines from CV scores."""
+    all_scores = []
+    for metric_name, scores in (
+        machine.metadata.build_metadata.model.cross_validation.scores.items()
+    ):
+        metric_name = metric_name.replace(" ", "-")
+        for score_name, score_val in scores.items():
+            score_name = score_name.replace(" ", "-")
+            all_scores.append(f"{metric_name}_{score_name}={score_val}")
+    return all_scores
+
+
+def _maybe_inject_fault():
+    fault = os.environ.get(FAULT_INJECTION_ENV)
+    if fault:
+        exc = _INJECTABLE_FAULTS.get(fault, Exception)
+        raise exc(f"fault injected via {FAULT_INJECTION_ENV}={fault}")
+
+
+@click.command()
+@click.argument("machine-config", envvar="MACHINE", type=yaml.safe_load)
+@click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option(
+    "--model-register-dir",
+    default=None,
+    envvar="MODEL_REGISTER_DIR",
+    type=click.Path(exists=False, file_okay=False, dir_okay=True),
+)
+@click.option(
+    "--print-cv-scores", help="Prints CV scores to stdout", is_flag=True, default=False
+)
+@click.option(
+    "--model-parameter",
+    type=key_value_par,
+    multiple=True,
+    default=(),
+    help="Key,value pair for model config jinja variables; repeatable.",
+)
+@click.option(
+    "--exceptions-reporter-file",
+    envvar="EXCEPTIONS_REPORTER_FILE",
+    help="JSON output file for exception information",
+)
+@click.option(
+    "--exceptions-report-level",
+    type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
+    default=ReportLevel.MESSAGE.name,
+    envvar="EXCEPTIONS_REPORT_LEVEL",
+    help="Detail level for exception reporting",
+)
+def build(
+    machine_config: dict,
+    output_dir: str,
+    model_register_dir,
+    print_cv_scores: bool,
+    model_parameter: List[Tuple[str, Any]],
+    exceptions_reporter_file: str,
+    exceptions_report_level: str,
+):
+    """Build a model for a single machine and deposit it into output_dir."""
+    try:
+        if model_parameter and isinstance(machine_config["model"], str):
+            parameters = dict(model_parameter)
+            machine_config["model"] = expand_model(
+                machine_config["model"], parameters
+            )
+
+        machine = Machine.from_config(
+            machine_config,
+            project_name=machine_config.get("project_name", "project"),
+        )
+
+        logger.info("Building, output will be at: %s", output_dir)
+
+        # round-trip the model config so all defaults are recorded
+        machine.model = serializer.into_definition(
+            serializer.from_definition(machine.model)
+        )
+
+        builder = ModelBuilder(machine=machine)
+        _, machine_out = builder.build(output_dir, model_register_dir)
+
+        machine_out.report()
+
+        _maybe_inject_fault()
+
+        if print_cv_scores:
+            for score in get_all_score_strings(machine_out):
+                print(score)
+
+    except Exception:
+        traceback.print_exc()
+        exc_type, exc_value, exc_traceback = sys.exc_info()
+        exit_code = _exceptions_reporter.exception_exit_code(exc_type)
+        if exceptions_reporter_file:
+            _exceptions_reporter.safe_report(
+                ReportLevel.get_by_name(
+                    exceptions_report_level, ReportLevel.EXIT_CODE
+                ),
+                exc_type,
+                exc_value,
+                exc_traceback,
+                exceptions_reporter_file,
+                max_message_len=2024 - 500,
+            )
+        sys.exit(exit_code)
+    return 0
+
+
+@click.command("batch-build")
+@click.argument("config-file", type=click.Path(exists=True), envvar="CONFIG_FILE")
+@click.option("--output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option("--project-name", default="batch", envvar="PROJECT_NAME")
+@click.option(
+    "--no-serial-fallback",
+    is_flag=True,
+    default=False,
+    help="Fail instead of falling back to serial builds for unbatchable models",
+)
+def batch_build(
+    config_file: str, output_dir: str, project_name: str, no_serial_fallback: bool
+):
+    """
+    Train EVERY machine in a config in one process on the device mesh
+    (the TPU-native replacement for per-machine worker pods).
+    """
+    from gordo_tpu.parallel import BatchedModelBuilder
+    from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+    with open(config_file) as f:
+        config = yaml.safe_load(f)
+    norm = NormalizedConfig(config, project_name=project_name)
+    builder = BatchedModelBuilder(
+        norm.machines, serial_fallback=not no_serial_fallback
+    )
+    results = builder.build()
+    for model, machine_out in results:
+        model_dir = os.path.join(output_dir, machine_out.name)
+        os.makedirs(model_dir, exist_ok=True)
+        serializer.dump(model, model_dir, metadata=machine_out.to_dict())
+        machine_out.report()
+        click.echo(f"built: {machine_out.name} -> {model_dir}")
+    return 0
+
+
+@click.command("run-server")
+@click.option(
+    "--host", type=HostIP(), default="0.0.0.0", envvar="GORDO_SERVER_HOST"
+)
+@click.option("--port", type=click.IntRange(1, 65535), default=5555, envvar="GORDO_SERVER_PORT")
+@click.option("--workers", type=click.IntRange(1, 4), default=2, envvar="GORDO_SERVER_WORKERS")
+@click.option(
+    "--worker-connections",
+    type=click.IntRange(1, 400),
+    default=50,
+    envvar="GORDO_SERVER_WORKER_CONNECTIONS",
+)
+def run_server_cli(host, port, workers, worker_connections):
+    """Run the gordo-tpu model server."""
+    from gordo_tpu.server import run_server
+
+    run_server(host, port, workers, worker_connections=worker_connections)
+
+
+gordo.add_command(build)
+gordo.add_command(batch_build)
+gordo.add_command(run_server_cli)
+
+
+def _append_workflow_commands():
+    # registered lazily so the CLI works before the workflow module lands
+    try:
+        from .workflow_generator import workflow_cli
+
+        gordo.add_command(workflow_cli)
+    except ImportError:
+        pass
+
+
+_append_workflow_commands()
+
+if __name__ == "__main__":
+    gordo()

@@ -1,0 +1,25 @@
+"""
+Custom Click parameter types (reference: gordo/cli/custom_types.py:8-27).
+"""
+
+import ipaddress
+
+import click
+
+
+class HostIP(click.ParamType):
+    """Validate that the input is a parseable IP address."""
+
+    name = "host_ip"
+
+    def convert(self, value, param, ctx):
+        try:
+            ipaddress.ip_address(value)
+            return value
+        except ValueError:
+            self.fail(f"{value!r} is not a valid IP address", param, ctx)
+
+
+def key_value_par(val) -> tuple:
+    """Parse 'key,value' into (key, value)."""
+    return tuple(val.split(",", 1))

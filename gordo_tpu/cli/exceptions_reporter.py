@@ -1,0 +1,111 @@
+"""
+Exception → JSON report + stable exit codes.
+
+Reference parity: gordo/cli/exceptions_reporter.py:12-224 — a report file
+(consumed as the k8s terminationMessagePath) with type/message/traceback
+trimmed to the 2024-byte termination-message limit, and an exit-code table
+ordered so subclasses win over base classes.
+"""
+
+import enum
+import json
+import traceback
+from typing import IO, List, Optional, Tuple, Type, Union
+
+from gordo_tpu.util.text import replace_all_non_ascii_chars
+
+
+class ReportLevel(enum.Enum):
+    EXIT_CODE = 0
+    TYPE = 1
+    MESSAGE = 2
+    TRACEBACK = 3
+
+    @classmethod
+    def get_by_name(cls, name: str, default: Optional["ReportLevel"] = None):
+        for level in cls:
+            if level.name == name.upper():
+                return level
+        return default
+
+    @classmethod
+    def get_names(cls) -> List[str]:
+        return [level.name for level in cls]
+
+
+DEFAULT_EXIT_CODE = 1
+
+
+class ExceptionsReporter:
+    """
+    Map exception types to exit codes and write JSON crash reports.
+
+    The exception table is sorted so that more-derived exception classes take
+    precedence regardless of declaration order.
+    """
+
+    def __init__(
+        self,
+        exceptions: Tuple[Tuple[Type[Exception], int], ...],
+        default_exit_code: int = DEFAULT_EXIT_CODE,
+    ):
+        # subclasses first so the first match is the most specific
+        self.exceptions = sorted(
+            exceptions, key=lambda pair: len(pair[0].__mro__), reverse=True
+        )
+        self.default_exit_code = default_exit_code
+
+    def exception_exit_code(self, exc_type: Optional[Type[Exception]]) -> int:
+        if exc_type is None:
+            return 0
+        for klass, exit_code in self.exceptions:
+            if issubclass(exc_type, klass):
+                return exit_code
+        return self.default_exit_code
+
+    @staticmethod
+    def trim_message(message: str, max_length: int) -> str:
+        if len(message) > max_length:
+            return message[: max_length - 3] + "..."
+        return message
+
+    def report(
+        self,
+        level: ReportLevel,
+        exc_type: Optional[Type[Exception]],
+        exc_value: Optional[Exception],
+        exc_traceback,
+        report_file: IO[str],
+        max_message_len: Optional[int] = None,
+    ):
+        doc: dict = {}
+        if exc_type is not None:
+            if level.value >= ReportLevel.TYPE.value:
+                doc["type"] = exc_type.__name__
+            if level.value >= ReportLevel.MESSAGE.value:
+                message = replace_all_non_ascii_chars(str(exc_value))
+                if max_message_len is not None:
+                    message = self.trim_message(message, max_message_len)
+                doc["message"] = message
+            if level.value >= ReportLevel.TRACEBACK.value and exc_traceback is not None:
+                tb = "".join(traceback.format_tb(exc_traceback))
+                doc["traceback"] = replace_all_non_ascii_chars(tb)
+        doc["exit_code"] = self.exception_exit_code(exc_type)
+        json.dump(doc, report_file)
+
+    def safe_report(
+        self,
+        level: ReportLevel,
+        exc_type,
+        exc_value,
+        exc_traceback,
+        report_file_path: str,
+        max_message_len: Optional[int] = None,
+    ):
+        try:
+            with open(report_file_path, "w") as f:
+                self.report(
+                    level, exc_type, exc_value, exc_traceback, f, max_message_len
+                )
+        except Exception:  # reporting must never mask the original failure
+            traceback.print_exc()

@@ -26,7 +26,3 @@ def default_mesh(
 def machines_sharding(mesh: Mesh, axis_name: str = "machines") -> NamedSharding:
     """Shard the leading (machine) axis across the mesh; replicate the rest."""
     return NamedSharding(mesh, PartitionSpec(axis_name))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,18 @@
+"""
+Model output extraction (reference: gordo/server/model_io.py:16-41).
+"""
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def get_model_output(model, X) -> np.ndarray:
+    """Predict, falling back to transform when the model has no predict."""
+    try:
+        return model.predict(X)
+    except AttributeError:
+        logger.debug("Model has no predict, falling back to transform")
+        return model.transform(X)

@@ -1,0 +1,3 @@
+from .metrics import GordoServerPrometheusMetrics
+
+__all__ = ["GordoServerPrometheusMetrics"]

@@ -1,0 +1,101 @@
+"""
+Prometheus metrics for the model server.
+
+Reference parity: gordo/server/prometheus/metrics.py:33-141 — request
+duration histogram + request counter labeled by (method, path, status_code,
+gordo_name, project), plus a version-info gauge. Multiprocess registry
+supported via the standard prometheus_client env var.
+"""
+
+import contextlib
+import os
+import re
+import timeit
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from gordo_tpu import __version__
+
+_NAME_RE = re.compile(r"/gordo/v0/[^/]+/([^/]+)/")
+
+
+def create_registry() -> CollectorRegistry:
+    registry = CollectorRegistry()
+    if "PROMETHEUS_MULTIPROC_DIR" in os.environ or "prometheus_multiproc_dir" in os.environ:
+        from prometheus_client import multiprocess
+
+        multiprocess.MultiProcessCollector(registry)
+    return registry
+
+
+class GordoServerPrometheusMetrics:
+    def __init__(
+        self,
+        project: Optional[str] = None,
+        registry: Optional[CollectorRegistry] = None,
+    ):
+        self.project = project or "unknown"
+        self.registry = registry if registry is not None else create_registry()
+        self.request_duration = Histogram(
+            "gordo_server_request_duration_seconds",
+            "HTTP request duration",
+            ["method", "path", "status_code", "gordo_name", "project"],
+            registry=self.registry,
+        )
+        self.request_count = Counter(
+            "gordo_server_requests_total",
+            "HTTP request count",
+            ["method", "path", "status_code", "gordo_name", "project"],
+            registry=self.registry,
+        )
+        self.version_info = Gauge(
+            "gordo_server_info",
+            "Server version info",
+            ["version", "project"],
+            registry=self.registry,
+        )
+        self.version_info.labels(version=__version__, project=self.project).set(1)
+        self._start = None
+
+    @contextlib.contextmanager
+    def observe(self, request):
+        self._start = timeit.default_timer()
+        yield
+
+    def record(self, request, response):
+        duration = timeit.default_timer() - (self._start or timeit.default_timer())
+        match = _NAME_RE.search(request.path)
+        gordo_name = match.group(1) if match else ""
+        labels = dict(
+            method=request.method,
+            path=request.path,
+            status_code=str(response.status_code),
+            gordo_name=gordo_name,
+            project=self.project,
+        )
+        self.request_duration.labels(**labels).observe(duration)
+        self.request_count.labels(**labels).inc()
+
+    def expose(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def metrics_app(metrics: GordoServerPrometheusMetrics):
+    """Standalone WSGI /metrics app (reference prometheus/server.py:7-27)."""
+
+    def app(environ, start_response):
+        body = metrics.expose()
+        start_response(
+            "200 OK",
+            [("Content-Type", "text/plain; version=0.0.4"), ("Content-Length", str(len(body)))],
+        )
+        return [body]
+
+    return app

@@ -1,0 +1,219 @@
+"""
+The model server: a plain WSGI application on werkzeug.
+
+Reference parity: gordo/server/server.py:36-297 — same env-driven config
+(MODEL_COLLECTION_DIR, EXPECTED_MODELS, ENABLE_PROMETHEUS, PROJECT), same
+route table, same per-request revision resolution (?revision= / header with
+410 on missing), same response post-processing (revision key+header,
+Server-Timing header), /healthcheck and /server-version.
+
+Differences by design: no Flask/gunicorn dependency — the app is a small
+werkzeug-routed WSGI callable; ``run_server`` serves it with a threaded
+werkzeug server (model inference is released-GIL device compute, so threads
+scale; multiple processes can still be run behind any WSGI server).
+"""
+
+import json
+import logging
+import os
+import timeit
+from typing import Any, Dict, List, Optional
+
+import simplejson
+from werkzeug.exceptions import HTTPException
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from gordo_tpu import __version__
+from gordo_tpu.server import views
+
+logger = logging.getLogger(__name__)
+
+
+def default_config() -> Dict[str, Any]:
+    expected_models = os.environ.get("EXPECTED_MODELS")
+    return {
+        "MODEL_COLLECTION_DIR": os.environ.get("MODEL_COLLECTION_DIR"),
+        "EXPECTED_MODELS": json.loads(expected_models) if expected_models else [],
+        "ENABLE_PROMETHEUS": os.environ.get("ENABLE_PROMETHEUS", "false").lower()
+        in ("1", "true", "yes"),
+        "PROJECT": os.environ.get("PROJECT"),
+    }
+
+
+class RequestContext:
+    """Per-request state (the no-flask equivalent of flask.g)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.start_time = timeit.default_timer()
+        self.collection_dir: Optional[str] = None
+        self.current_revision: Optional[str] = None
+        self.revision: Optional[str] = None
+
+
+class GordoServer:
+    url_map = Map(
+        [
+            Rule("/healthcheck", endpoint="healthcheck"),
+            Rule("/server-version", endpoint="server_version"),
+            Rule(
+                "/gordo/v0/<gordo_project>/models",
+                endpoint="model_list",
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/expected-models",
+                endpoint="expected_models",
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/revisions",
+                endpoint="revision_list",
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/<gordo_name>/prediction",
+                endpoint="base_prediction",
+                methods=["POST"],
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/<gordo_name>/anomaly/prediction",
+                endpoint="anomaly_prediction",
+                methods=["POST"],
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/<gordo_name>/metadata",
+                endpoint="metadata_view",
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/<gordo_name>/healthcheck",
+                endpoint="metadata_view",
+            ),
+            Rule(
+                "/gordo/v0/<gordo_project>/<gordo_name>/download-model",
+                endpoint="download_model",
+            ),
+        ],
+        strict_slashes=False,
+    )
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = default_config()
+        if config:
+            self.config.update(config)
+        self.testing = False
+        self._prometheus = None
+        if self.config["ENABLE_PROMETHEUS"]:
+            from gordo_tpu.server.prometheus.metrics import (
+                GordoServerPrometheusMetrics,
+            )
+
+            self._prometheus = GordoServerPrometheusMetrics(
+                project=self.config.get("PROJECT")
+            )
+
+    # ------------------------------------------------------------ dispatch
+    def _resolve_revision(self, ctx: RequestContext, request: Request):
+        """?revision=/header override with 410 on missing (ref :171-189)."""
+        collection_dir = self.config.get("MODEL_COLLECTION_DIR") or os.environ.get(
+            "MODEL_COLLECTION_DIR", ""
+        )
+        ctx.collection_dir = collection_dir
+        ctx.current_revision = os.path.basename(os.path.normpath(collection_dir or ""))
+        revision = request.args.get("revision") or request.headers.get("revision")
+        if revision:
+            candidate = os.path.join(collection_dir, "..", revision)
+            if not os.path.isdir(candidate):
+                ctx.revision = revision
+                return Response(
+                    simplejson.dumps({"error": f"Revision '{revision}' not found."}),
+                    status=410,
+                    mimetype="application/json",
+                )
+            ctx.collection_dir = candidate
+            ctx.revision = revision
+        else:
+            ctx.revision = ctx.current_revision
+        return None
+
+    def dispatch_request(self, request: Request) -> Response:
+        ctx = RequestContext(self.config)
+        adapter = self.url_map.bind_to_environ(request.environ)
+        try:
+            endpoint, values = adapter.match()
+        except HTTPException as exc:
+            return exc.get_response()
+
+        error = self._resolve_revision(ctx, request)
+        if error is not None:
+            response = error
+        else:
+            try:
+                if endpoint == "healthcheck":
+                    response = Response("", status=200)
+                elif endpoint == "server_version":
+                    response = views.json_response(ctx, {"version": __version__})
+                else:
+                    handler = getattr(views, endpoint)
+                    response = handler(ctx, request, **values)
+            except HTTPException as exc:
+                response = exc.get_response()
+            except Exception:
+                logger.exception("Unhandled server error")
+                response = Response(
+                    simplejson.dumps({"error": "Internal server error"}),
+                    status=500,
+                    mimetype="application/json",
+                )
+
+        runtime_s = timeit.default_timer() - ctx.start_time
+        response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        if ctx.revision:
+            response.headers["revision"] = ctx.revision
+        return response
+
+    def wsgi_app(self, environ, start_response):
+        request = Request(environ)
+        if self._prometheus is not None:
+            with self._prometheus.observe(request):
+                response = self.dispatch_request(request)
+                self._prometheus.record(request, response)
+        else:
+            response = self.dispatch_request(request)
+        return response(environ, start_response)
+
+    def __call__(self, environ, start_response):
+        return self.wsgi_app(environ, start_response)
+
+    # ------------------------------------------------------- test support
+    def test_client(self):
+        from werkzeug.test import Client
+
+        return Client(self)
+
+
+def build_app(
+    config: Optional[Dict[str, Any]] = None, prometheus_registry=None
+) -> GordoServer:
+    """Build the WSGI app (reference build_app, server.py:139-231)."""
+    app = GordoServer(config)
+    if prometheus_registry is not None and app._prometheus is not None:
+        app._prometheus.registry = prometheus_registry
+    return app
+
+
+def run_server(
+    host: str = "0.0.0.0",
+    port: int = 5555,
+    workers: int = 2,
+    worker_connections: int = 50,
+    **kwargs,
+):
+    """
+    Serve the app (reference run_server shells out to gunicorn,
+    server.py:233-297; here: threaded werkzeug server — device compute
+    releases the GIL, so threads provide the request concurrency).
+    """
+    from werkzeug.serving import run_simple
+
+    app = build_app()
+    logger.info("Starting server on %s:%s", host, port)
+    run_simple(host, port, app, threaded=True, processes=1)

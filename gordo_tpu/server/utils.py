@@ -1,0 +1,148 @@
+"""
+Server request/response plumbing: parquet↔dataframe, MultiIndex df↔dict,
+model/metadata caches.
+
+Behavioral parity: gordo/server/utils.py:37-419 — the dict serialization
+format of MultiIndex frames and the parquet payload convention are the wire
+contract the gordo client speaks, so they match exactly. Model cache keeps
+the most-recent N models' parameters resident (on TPU: device-resident
+pytrees, so repeat requests skip host→device transfer).
+"""
+
+import io
+import logging
+import os
+import pickle
+import zlib
+from datetime import datetime
+from functools import lru_cache
+from typing import List, Optional, Union
+
+import dateutil.parser
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from gordo_tpu import serializer
+
+logger = logging.getLogger(__name__)
+
+
+def dataframe_into_parquet_bytes(df: pd.DataFrame, compression: str = "snappy") -> bytes:
+    """Serialize a dataframe as parquet bytes (snappy, like the reference)."""
+    table = pa.Table.from_pandas(df)
+    buf = pa.BufferOutputStream()
+    pq.write_table(table, buf, compression=compression)
+    return buf.getvalue().to_pybytes()
+
+
+def dataframe_from_parquet_bytes(buf: bytes) -> pd.DataFrame:
+    """Parse parquet bytes into a dataframe."""
+    table = pq.read_table(io.BytesIO(buf))
+    return table.to_pandas()
+
+
+def dataframe_to_dict(df: pd.DataFrame) -> dict:
+    """
+    JSON-safe dict form of a (possibly MultiIndex-column) dataframe.
+
+    >>> import numpy as np
+    >>> columns = pd.MultiIndex.from_tuples(
+    ...     (f"feature{i}", f"sub-feature-{ii}") for i in range(2) for ii in range(2))
+    >>> index = pd.date_range('2019-01-01', '2019-02-01', periods=2)
+    >>> df = pd.DataFrame(np.arange(8).reshape((2, 4)), columns=columns, index=index)
+    >>> d = dataframe_to_dict(df)
+    >>> sorted(d['feature0']['sub-feature-0'].values())
+    [0, 4]
+    """
+    data = df.copy()
+    if isinstance(data.index, pd.DatetimeIndex):
+        data.index = data.index.astype(str)
+    if isinstance(df.columns, pd.MultiIndex):
+        return {
+            col: data[col].to_dict()
+            if isinstance(data[col], pd.DataFrame)
+            else pd.DataFrame(data[col]).to_dict()
+            for col in data.columns.get_level_values(0)
+        }
+    return data.to_dict()
+
+
+def dataframe_from_dict(data: dict) -> pd.DataFrame:
+    """Inverse of :func:`dataframe_to_dict` (also accepts plain 2D payloads)."""
+    if isinstance(data, dict) and any(isinstance(val, dict) for val in data.values()):
+        try:
+            keys = data.keys()
+            df: pd.DataFrame = pd.concat(
+                (pd.DataFrame.from_dict(data[key]) for key in keys), axis=1, keys=keys
+            )
+        except (ValueError, AttributeError):
+            df = pd.DataFrame.from_dict(data)
+    else:
+        df = pd.DataFrame(data)
+
+    try:
+        df.index = df.index.map(dateutil.parser.isoparse)
+    except (TypeError, ValueError):
+        df.index = df.index.map(int)
+    df.sort_index(inplace=True)
+    return df
+
+
+def parse_iso_datetime(datetime_str: str) -> datetime:
+    parsed_date = dateutil.parser.isoparse(datetime_str)
+    if parsed_date.tzinfo is None:
+        raise ValueError(
+            f"Provide timezone to timestamp {datetime_str}. "
+            f"Example: {datetime_str + 'Z'} or {datetime_str + '+00:00'}"
+        )
+    return parsed_date
+
+
+class BadDataFrame(ValueError):
+    """Raised when a request payload cannot be coerced to the expected shape."""
+
+
+def verify_dataframe(df: pd.DataFrame, expected_columns: List[str]) -> pd.DataFrame:
+    """
+    Coerce/verify request data against the model's tag columns
+    (reference server/utils.py:200-246): unlabeled data of the right width is
+    assumed ordered; labeled data is selected down to the expected columns.
+    """
+    if isinstance(df.columns, pd.MultiIndex):
+        raise BadDataFrame(
+            f"Server does not support multi-level dataframes: {df.columns.tolist()}"
+        )
+    if not all(col in df.columns for col in expected_columns):
+        if len(df.columns) != len(expected_columns):
+            raise BadDataFrame(
+                f"Unexpected features: was expecting {expected_columns} "
+                f"length of {len(expected_columns)}, but got {list(df.columns)} "
+                f"length of {len(df.columns)}"
+            )
+        df.columns = expected_columns
+        return df
+    return df[expected_columns]
+
+
+# ------------------------------------------------------------------- caches
+@lru_cache(maxsize=int(os.getenv("N_CACHED_MODELS", 2)))
+def load_model(directory: str, name: str):
+    """Load (and cache) a model; params stay device-resident across requests."""
+    return serializer.load(os.path.join(directory, name))
+
+
+@lru_cache(maxsize=25000)
+def _load_compressed_metadata(directory: str, name: str) -> bytes:
+    metadata = serializer.load_metadata(os.path.join(directory, name))
+    return zlib.compress(pickle.dumps(metadata))
+
+
+def load_metadata(directory: str, name: str) -> dict:
+    """Load metadata via a zlib-compressed-pickle LRU (reference :346-379)."""
+    return pickle.loads(zlib.decompress(_load_compressed_metadata(directory, name)))
+
+
+def clear_model_caches():
+    load_model.cache_clear()
+    _load_compressed_metadata.cache_clear()

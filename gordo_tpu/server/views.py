@@ -1,0 +1,272 @@
+"""
+Route handlers.
+
+Route table and response shapes mirror the reference
+(gordo/server/views/base.py:119-297, views/anomaly.py:53-165): model
+prediction, anomaly prediction (smoothed columns dropped unless
+``?all_columns``), metadata, download-model, model/revision listings.
+Implemented as plain functions over a per-request context (no flask.g).
+"""
+
+import io
+import logging
+import os
+import timeit
+import traceback
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+from werkzeug.exceptions import NotFound
+from werkzeug.wrappers import Response
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.dataset.sensor_tag import SensorTag, normalize_sensor_tags
+from gordo_tpu.models import utils as model_utils
+from gordo_tpu.server import model_io
+from gordo_tpu.server import utils as server_utils
+
+logger = logging.getLogger(__name__)
+
+DELETED_FROM_RESPONSE_COLUMNS = (
+    "smooth-tag-anomaly-scaled",
+    "smooth-total-anomaly-scaled",
+    "smooth-tag-anomaly-unscaled",
+    "smooth-total-anomaly-unscaled",
+)
+
+
+def json_response(ctx, payload: dict, status: int = 200) -> Response:
+    import simplejson
+
+    payload = dict(payload)
+    payload["revision"] = ctx.revision
+    return Response(
+        simplejson.dumps(payload, ignore_nan=True, default=str),
+        status=status,
+        mimetype="application/json",
+    )
+
+
+class ModelContext:
+    """Per-request model context: resolves model, metadata, and tags."""
+
+    def __init__(self, ctx, gordo_name: str):
+        self.ctx = ctx
+        self.gordo_name = gordo_name
+        self._model = None
+        self._metadata = None
+
+    @property
+    def model(self):
+        if self._model is None:
+            try:
+                self._model = server_utils.load_model(
+                    self.ctx.collection_dir, self.gordo_name
+                )
+            except FileNotFoundError:
+                raise NotFound(f"No such model found: '{self.gordo_name}'")
+        return self._model
+
+    @property
+    def metadata(self) -> dict:
+        if self._metadata is None:
+            try:
+                self._metadata = server_utils.load_metadata(
+                    self.ctx.collection_dir, self.gordo_name
+                )
+            except FileNotFoundError:
+                raise NotFound(f"No model found for '{self.gordo_name}'")
+        return self._metadata
+
+    @property
+    def tags(self):
+        dataset_meta = self.metadata["dataset"]
+        tag_list = dataset_meta.get("tag_list") or dataset_meta.get("tags") or []
+        return normalize_sensor_tags(tag_list, asset=dataset_meta.get("asset"))
+
+    @property
+    def target_tags(self):
+        dataset_meta = self.metadata["dataset"]
+        target = dataset_meta.get("target_tag_list")
+        if target:
+            return normalize_sensor_tags(target, asset=dataset_meta.get("asset"))
+        return self.tags
+
+    @property
+    def frequency(self):
+        return pd.tseries.frequencies.to_offset(
+            self.metadata["dataset"].get("resolution", "10min")
+        )
+
+
+def extract_X_y(request, mc: ModelContext):
+    """
+    Pull X (and optional y) from a JSON or multipart-parquet POST and verify
+    columns against the model's tags (reference server/utils.py:249-320).
+    Returns (X, y) or raises BadDataFrame/ValueError.
+    """
+    payload = request.get_json(silent=True) if request.is_json else None
+    if (payload is None or "X" not in payload) and "X" not in request.files:
+        raise server_utils.BadDataFrame('Cannot predict without "X"')
+
+    if payload is not None:
+        X = server_utils.dataframe_from_dict(payload["X"])
+        y = payload.get("y")
+        if y is not None:
+            y = server_utils.dataframe_from_dict(y)
+    else:
+        X = server_utils.dataframe_from_parquet_bytes(request.files["X"].read())
+        y = request.files.get("y")
+        if y is not None:
+            y = server_utils.dataframe_from_parquet_bytes(y.read())
+
+    X = server_utils.verify_dataframe(X, [t.name for t in mc.tags])
+    if y is not None:
+        y = server_utils.verify_dataframe(y, [t.name for t in mc.target_tags])
+    return X, y
+
+
+# ------------------------------------------------------------------- routes
+def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    mc = ModelContext(ctx, gordo_name)
+    mc.model  # force 404 before payload parsing
+    try:
+        X, y = extract_X_y(request, mc)
+    except (server_utils.BadDataFrame, ValueError) as exc:
+        return json_response(ctx, {"message": str(exc)}, 400)
+
+    context: dict = {}
+    start = timeit.default_timer()
+    try:
+        output = model_io.get_model_output(model=mc.model, X=X)
+    except ValueError as err:
+        logger.error("Failed to predict: %s\n%s", err, traceback.format_exc())
+        context["error"] = f"ValueError: {str(err)}"
+        return json_response(ctx, context, 400)
+    except Exception:
+        logger.error("Failed to predict:\n%s", traceback.format_exc())
+        context["error"] = "Something unexpected happened; check your input data"
+        return json_response(ctx, context, 400)
+
+    data = model_utils.make_base_dataframe(
+        tags=mc.tags,
+        model_input=X.values if isinstance(X, pd.DataFrame) else X,
+        model_output=output,
+        target_tag_list=mc.target_tags,
+        index=X.index,
+    )
+    if request.args.get("format") == "parquet":
+        return Response(
+            server_utils.dataframe_into_parquet_bytes(data),
+            mimetype="application/octet-stream",
+        )
+    context["data"] = server_utils.dataframe_to_dict(data)
+    context["time-seconds"] = f"{timeit.default_timer() - start:.4f}"
+    return json_response(ctx, context, 200)
+
+
+def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    start_time = timeit.default_timer()
+    mc = ModelContext(ctx, gordo_name)
+
+    if not hasattr(mc.model, "anomaly"):
+        return json_response(
+            ctx,
+            {
+                "message": f"Model is not an AnomalyDetector, it is of type: {type(mc.model)}"
+            },
+            422,
+        )
+
+    try:
+        X, y = extract_X_y(request, mc)
+    except (server_utils.BadDataFrame, ValueError) as exc:
+        return json_response(ctx, {"message": str(exc)}, 400)
+
+    if y is None:
+        return json_response(
+            ctx, {"message": "Cannot perform anomaly detection without 'y'"}, 400
+        )
+
+    try:
+        anomaly_df = mc.model.anomaly(X, y, frequency=mc.frequency)
+    except AttributeError as exc:
+        return json_response(
+            ctx,
+            {
+                "message": f"Model is not complete; cannot compute anomalies: {exc}"
+            },
+            422,
+        )
+
+    if request.args.get("all_columns") is None:
+        drop = [
+            c
+            for c in anomaly_df.columns.get_level_values(0).unique()
+            if c in DELETED_FROM_RESPONSE_COLUMNS
+        ]
+        anomaly_df = anomaly_df.drop(columns=drop, level=0)
+
+    if request.args.get("format") == "parquet":
+        return Response(
+            server_utils.dataframe_into_parquet_bytes(anomaly_df),
+            mimetype="application/octet-stream",
+        )
+    context = {
+        "data": server_utils.dataframe_to_dict(anomaly_df),
+        "time-seconds": f"{timeit.default_timer() - start_time:.4f}",
+    }
+    return json_response(ctx, context, 200)
+
+
+def metadata_view(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    mc = ModelContext(ctx, gordo_name)
+    return json_response(
+        ctx,
+        {
+            "gordo-server-version": __version__,
+            "metadata": mc.metadata,
+            "env": {"MODEL_COLLECTION_DIR": os.environ.get("MODEL_COLLECTION_DIR")},
+        },
+    )
+
+
+def download_model(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    mc = ModelContext(ctx, gordo_name)
+    serialized_model = serializer.dumps(mc.model)
+    return Response(
+        serialized_model,
+        mimetype="application/octet-stream",
+        headers={"Content-Disposition": "attachment; filename=model.tar.gz"},
+    )
+
+
+def model_list(ctx, request, gordo_project: str) -> Response:
+    try:
+        available_models = sorted(os.listdir(ctx.collection_dir))
+    except FileNotFoundError:
+        available_models = []
+    return json_response(ctx, {"models": available_models})
+
+
+def revision_list(ctx, request, gordo_project: str) -> Response:
+    try:
+        available_revisions = sorted(
+            os.listdir(os.path.join(ctx.collection_dir, ".."))
+        )
+    except FileNotFoundError:
+        logger.error(
+            "Attempted to list directories above %s:\n%s",
+            ctx.collection_dir,
+            traceback.format_exc(),
+        )
+        available_revisions = [ctx.current_revision]
+    return json_response(
+        ctx,
+        {"latest": ctx.current_revision, "available-revisions": available_revisions},
+    )
+
+
+def expected_models(ctx, request, gordo_project: str) -> Response:
+    return json_response(ctx, {"expected-models": ctx.config.get("EXPECTED_MODELS", [])})

@@ -1,0 +1,168 @@
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli.cli import build, expand_model, gordo
+from gordo_tpu.cli.custom_types import HostIP, key_value_par
+from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+
+
+def machine_yaml(name="cli-machine"):
+    return yaml.safe_dump(
+        {
+            "name": name,
+            "project_name": "cli-project",
+            "dataset": {
+                "type": "RandomDataset",
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+                "tags": ["tag-0", "tag-1"],
+            },
+            "model": {
+                "gordo_tpu.models.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 1,
+                }
+            },
+        }
+    )
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_cli_version(runner):
+    result = runner.invoke(gordo, ["--version"])
+    assert result.exit_code == 0
+
+
+def test_build_command(runner, tmp_path):
+    out_dir = tmp_path / "out"
+    result = runner.invoke(
+        build, [machine_yaml(), str(out_dir)], catch_exceptions=False
+    )
+    assert result.exit_code == 0
+    assert (out_dir / "model.pkl").exists()
+    assert (out_dir / "metadata.json").exists()
+
+
+def test_build_print_cv_scores(runner, tmp_path):
+    result = runner.invoke(
+        build,
+        [machine_yaml(), str(tmp_path / "out"), "--print-cv-scores"],
+    )
+    assert result.exit_code == 0
+    assert "r2-score_fold-mean=" in result.output
+
+
+def test_build_model_parameter_expansion(runner, tmp_path):
+    config = {
+        "name": "jinja-machine",
+        "project_name": "cli-project",
+        "dataset": {
+            "type": "RandomDataset",
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-02T00:00:00+00:00",
+            "tags": ["tag-0", "tag-1"],
+        },
+        # model as a jinja-templated string
+        "model": """
+            gordo_tpu.models.models.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: {{ n_epochs }}
+        """,
+    }
+    result = runner.invoke(
+        build,
+        [
+            yaml.safe_dump(config),
+            str(tmp_path / "out"),
+            "--model-parameter",
+            "n_epochs,1",
+        ],
+    )
+    assert result.exit_code == 0
+
+
+def test_build_fault_injection_exit_code_and_report(runner, tmp_path, monkeypatch):
+    report_file = tmp_path / "report.json"
+    monkeypatch.setenv("GORDO_TPU_FAULT_INJECTION", "FileNotFoundError")
+    result = runner.invoke(
+        build,
+        [
+            machine_yaml(),
+            str(tmp_path / "out"),
+            "--exceptions-reporter-file",
+            str(report_file),
+            "--exceptions-report-level",
+            "MESSAGE",
+        ],
+    )
+    assert result.exit_code == 30  # FileNotFoundError exit code
+    report = json.loads(report_file.read_text())
+    assert report["type"] == "FileNotFoundError"
+    assert report["exit_code"] == 30
+
+
+def test_batch_build_command(runner, tmp_path):
+    config = {
+        "machines": [
+            yaml.safe_load(machine_yaml("batch-a")),
+            yaml.safe_load(machine_yaml("batch-b")),
+        ]
+    }
+    for m in config["machines"]:
+        del m["project_name"]
+    config_file = tmp_path / "config.yaml"
+    config_file.write_text(yaml.safe_dump(config))
+    out_dir = tmp_path / "models"
+    result = runner.invoke(
+        gordo,
+        ["batch-build", str(config_file), "--output-dir", str(out_dir)],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    assert (out_dir / "batch-a" / "model.pkl").exists()
+    assert (out_dir / "batch-b" / "metadata.json").exists()
+
+
+def test_expand_model_undefined_raises():
+    with pytest.raises(ValueError):
+        expand_model("model: {{ missing }}", {})
+
+
+def test_key_value_par():
+    assert key_value_par("a,b") == ("a", "b")
+    assert key_value_par("a,b,c") == ("a", "b,c")
+
+
+def test_exceptions_reporter_subclass_precedence():
+    class Custom(FileNotFoundError):
+        pass
+
+    reporter = ExceptionsReporter(((Exception, 1), (FileNotFoundError, 30)))
+    assert reporter.exception_exit_code(Custom) == 30
+    assert reporter.exception_exit_code(KeyError) == 1
+    assert reporter.exception_exit_code(None) == 0
+
+
+def test_report_levels(tmp_path):
+    reporter = ExceptionsReporter(((Exception, 1),))
+    report_file = tmp_path / "r.json"
+    try:
+        raise ValueError("boom ☃")  # non-ascii snowman gets scrubbed
+    except ValueError:
+        import sys
+
+        reporter.safe_report(
+            ReportLevel.TRACEBACK, *sys.exc_info(), str(report_file)
+        )
+    doc = json.loads(report_file.read_text())
+    assert doc["type"] == "ValueError"
+    assert "?" in doc["message"]  # non-ascii scrubbed
+    assert "traceback" in doc

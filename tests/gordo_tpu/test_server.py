@@ -1,0 +1,206 @@
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.server import build_app
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.server.utils import (
+    dataframe_from_dict,
+    dataframe_from_parquet_bytes,
+    dataframe_into_parquet_bytes,
+    dataframe_to_dict,
+)
+
+
+@pytest.fixture(scope="module")
+def app(model_collection_directory, trained_model_directories):
+    server_utils.clear_model_caches()
+    return build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+
+
+@pytest.fixture(scope="module")
+def client(app):
+    return app.test_client()
+
+
+@pytest.fixture(scope="module")
+def X_payload(sensors):
+    idx = pd.date_range("2020-01-01", periods=20, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        np.random.RandomState(0).rand(20, 4),
+        columns=[t.name for t in sensors],
+        index=idx,
+    )
+    return X
+
+
+def test_healthcheck(client):
+    resp = client.get("/healthcheck")
+    assert resp.status_code == 200
+
+
+def test_server_version(client):
+    resp = client.get("/server-version")
+    assert resp.status_code == 200
+    assert "version" in resp.get_json()
+
+
+def test_model_list(client, gordo_project, gordo_name, second_gordo_name):
+    resp = client.get(f"/gordo/v0/{gordo_project}/models")
+    assert resp.status_code == 200
+    models = resp.get_json()["models"]
+    assert gordo_name in models and second_gordo_name in models
+
+
+def test_revision_list(client, gordo_project, gordo_revision):
+    resp = client.get(f"/gordo/v0/{gordo_project}/revisions")
+    body = resp.get_json()
+    assert body["latest"] == gordo_revision
+    assert gordo_revision in body["available-revisions"]
+
+
+def test_expected_models(client, gordo_project):
+    resp = client.get(f"/gordo/v0/{gordo_project}/expected-models")
+    assert resp.status_code == 200
+    assert "expected-models" in resp.get_json()
+
+
+def test_metadata(client, gordo_project, gordo_name):
+    resp = client.get(f"/gordo/v0/{gordo_project}/{gordo_name}/metadata")
+    assert resp.status_code == 200
+    body = resp.get_json()
+    assert body["metadata"]["name"] == gordo_name
+    assert resp.headers["revision"]
+
+
+def test_metadata_unknown_model_404(client, gordo_project):
+    resp = client.get(f"/gordo/v0/{gordo_project}/no-such-model/metadata")
+    assert resp.status_code == 404
+
+
+def test_revision_missing_410(client, gordo_project, gordo_name):
+    resp = client.get(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/metadata?revision=999"
+    )
+    assert resp.status_code == 410
+    assert "not found" in resp.get_json()["error"]
+
+
+def test_prediction_json(client, gordo_project, gordo_name, X_payload):
+    payload = {"X": dataframe_to_dict(X_payload)}
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", json=payload
+    )
+    assert resp.status_code == 200
+    body = resp.get_json()
+    assert "data" in body
+    assert "model-output" in body["data"]
+    assert body["revision"]
+
+
+def test_prediction_missing_X_400(client, gordo_project, gordo_name):
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", json={"noX": 1}
+    )
+    assert resp.status_code == 400
+
+
+def test_prediction_wrong_width_400(client, gordo_project, gordo_name):
+    X = pd.DataFrame(np.random.rand(5, 2))
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        json={"X": dataframe_to_dict(X)},
+    )
+    assert resp.status_code == 400
+
+
+def test_anomaly_json(client, gordo_project, gordo_name, X_payload):
+    payload = {
+        "X": dataframe_to_dict(X_payload),
+        "y": dataframe_to_dict(X_payload),
+    }
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction", json=payload
+    )
+    assert resp.status_code == 200
+    data = resp.get_json()["data"]
+    assert "total-anomaly-scaled" in data
+    assert "tag-anomaly-scaled" in data
+    # smoothed columns dropped by default
+    assert not any(k.startswith("smooth-") for k in data)
+
+
+def test_anomaly_all_columns(
+    client, gordo_project, second_gordo_name, X_payload
+):
+    payload = {
+        "X": dataframe_to_dict(X_payload),
+        "y": dataframe_to_dict(X_payload),
+    }
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{second_gordo_name}/anomaly/prediction"
+        "?all_columns=true",
+        json=payload,
+    )
+    assert resp.status_code == 200
+    data = resp.get_json()["data"]
+    assert any(k.startswith("smooth-") for k in data)
+
+
+def test_anomaly_requires_y(client, gordo_project, gordo_name, X_payload):
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+        json={"X": dataframe_to_dict(X_payload)},
+    )
+    assert resp.status_code == 400
+    assert "y" in resp.get_json()["message"]
+
+
+def test_prediction_parquet_roundtrip(client, gordo_project, gordo_name, X_payload):
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction?format=parquet",
+        data={"X": (io_bytes(X_payload), "X")},
+    )
+    assert resp.status_code == 200
+    df = dataframe_from_parquet_bytes(resp.data)
+    assert "model-output" in df.columns.get_level_values(0)
+
+
+def io_bytes(df):
+    import io
+
+    return io.BytesIO(dataframe_into_parquet_bytes(df))
+
+
+def test_download_model(client, gordo_project, gordo_name, X_payload):
+    resp = client.get(f"/gordo/v0/{gordo_project}/{gordo_name}/download-model")
+    assert resp.status_code == 200
+    model = serializer.loads(resp.data)
+    assert hasattr(model, "anomaly")
+    out = model.predict(X_payload)
+    assert out.shape == (20, 4)
+
+
+def test_dataframe_dict_roundtrip(X_payload):
+    as_dict = dataframe_to_dict(X_payload)
+    df = dataframe_from_dict(as_dict)
+    assert np.allclose(df.values, X_payload.values)
+
+
+def test_prometheus_metrics(model_collection_directory):
+    app = build_app(
+        {
+            "MODEL_COLLECTION_DIR": model_collection_directory,
+            "ENABLE_PROMETHEUS": True,
+            "PROJECT": "test-proj",
+        }
+    )
+    client = app.test_client()
+    client.get("/healthcheck")
+    body = app._prometheus.expose().decode()
+    assert "gordo_server_requests_total" in body
+    assert 'project="test-proj"' in body
