@@ -61,7 +61,10 @@ _INJECTABLE_FAULTS = {
 @click.version_option(version=__version__, message=__version__)
 @click.option(
     "--log-level",
-    type=str,
+    type=click.Choice(
+        ["CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG"],
+        case_sensitive=False,
+    ),
     default="INFO",
     envvar="GORDO_LOG_LEVEL",
     help="Run with custom log-level.",
@@ -203,13 +206,25 @@ def build(
 @click.option("--output-dir", default="/data", envvar="OUTPUT_DIR")
 @click.option("--project-name", default="batch", envvar="PROJECT_NAME")
 @click.option(
+    "--machines",
+    default="",
+    envvar="MACHINES",
+    help="Comma-separated machine names: train only this subset of the "
+    "config (used by workflow chunk tasks, which pass names instead of "
+    "embedding full configs in workflow parameters)",
+)
+@click.option(
     "--no-serial-fallback",
     is_flag=True,
     default=False,
     help="Fail instead of falling back to serial builds for unbatchable models",
 )
 def batch_build(
-    config_file: str, output_dir: str, project_name: str, no_serial_fallback: bool
+    config_file: str,
+    output_dir: str,
+    project_name: str,
+    machines: str,
+    no_serial_fallback: bool,
 ):
     """
     Train EVERY machine in a config in one process on the device mesh
@@ -221,8 +236,18 @@ def batch_build(
     with open(config_file) as f:
         config = yaml.safe_load(f)
     norm = NormalizedConfig(config, project_name=project_name)
+    selected = norm.machines
+    if machines:
+        wanted = {name.strip() for name in machines.split(",") if name.strip()}
+        by_name = {m.name: m for m in norm.machines}
+        missing = wanted - set(by_name)
+        if missing:
+            raise click.ClickException(
+                f"--machines names not in config: {sorted(missing)}"
+            )
+        selected = [by_name[name] for name in sorted(wanted)]
     builder = BatchedModelBuilder(
-        norm.machines, serial_fallback=not no_serial_fallback
+        selected, serial_fallback=not no_serial_fallback
     )
     results = builder.build()
     for model, machine_out in results:

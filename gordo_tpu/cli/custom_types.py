@@ -22,4 +22,9 @@ class HostIP(click.ParamType):
 
 def key_value_par(val) -> tuple:
     """Parse 'key,value' into (key, value)."""
-    return tuple(val.split(",", 1))
+    parts = tuple(val.split(",", 1))
+    if len(parts) != 2:
+        raise click.BadParameter(
+            f"{val!r} is not of the form 'key,value' (missing comma)"
+        )
+    return parts

@@ -1,0 +1,459 @@
+"""
+``gordo-tpu workflow generate`` — config → TPU workflow documents.
+
+Reference parity: gordo/cli/workflow_generator.py:144-527 (the option surface:
+machine config / project name / images / HPA-KEDA knobs / retries / server
+sizing / custom builder envs / resource labels / split-workflows chunking /
+reporter injection) re-designed for TPU orchestration: instead of rendering
+one builder pod per machine (reference argo-workflow.yml.template:1511-1525),
+machines are grouped into batched TPU builder chunks, each trained in one
+process on a TPU-VM device mesh by ``gordo-tpu batch-build``.
+"""
+
+import json
+import logging
+import sys
+from typing import Any, Dict, List, Optional
+
+import click
+import yaml
+
+from gordo_tpu import __version__
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+from gordo_tpu.workflow.workflow_generator import (
+    chunk_machines,
+    default_image_pull_policy,
+    get_dict_from_yaml,
+    load_workflow_template,
+    sanitize_docker_tag,
+    validate_generate_owner_ref,
+)
+from .custom_types import key_value_par
+
+logger = logging.getLogger(__name__)
+
+PREFIX = "WORKFLOW_GENERATOR"
+
+
+@click.group("workflow")
+def workflow_cli():
+    """Commands for generating workflow documents from machine configs."""
+
+
+@workflow_cli.command("generate")
+@click.option(
+    "--machine-config",
+    type=str,
+    required=True,
+    envvar=f"{PREFIX}_MACHINE_CONFIG",
+    help="Machine configuration file (YAML, or a Gordo CRD)",
+)
+@click.option("--workflow-template", type=str, help="Template file to expand")
+@click.option(
+    "--project-name",
+    type=str,
+    required=True,
+    envvar=f"{PREFIX}_PROJECT_NAME",
+    help="Name of the project",
+)
+@click.option(
+    "--project-revision",
+    type=str,
+    default="1",
+    envvar=f"{PREFIX}_PROJECT_REVISION",
+)
+@click.option(
+    "--output-file",
+    type=str,
+    required=False,
+    help="Where to write the workflow documents (default: stdout)",
+)
+@click.option(
+    "--docker-registry",
+    type=str,
+    default="ghcr.io/gordo-tpu",
+    envvar=f"{PREFIX}_DOCKER_REGISTRY",
+)
+@click.option(
+    "--docker-image",
+    type=str,
+    default="gordo-tpu",
+    envvar=f"{PREFIX}_DOCKER_IMAGE",
+)
+@click.option(
+    "--gordo-version",
+    type=str,
+    default=__version__,
+    envvar=f"{PREFIX}_GORDO_VERSION",
+    help="Version (docker tag) of gordo-tpu to deploy",
+)
+@click.option(
+    "--image-pull-policy",
+    type=click.Choice(["Always", "IfNotPresent", "Never", ""]),
+    default="",
+    help="Override the derived imagePullPolicy",
+)
+@click.option(
+    "--retries",
+    type=int,
+    default=5,
+    envvar=f"{PREFIX}_RETRIES",
+    help="Retry limit for builder/client tasks",
+)
+@click.option(
+    "--machines-per-tpu-worker",
+    type=int,
+    default=256,
+    envvar=f"{PREFIX}_MACHINES_PER_TPU_WORKER",
+    help="How many machines one batched TPU builder chunk trains",
+)
+@click.option(
+    "--tpu-accelerator-type",
+    type=str,
+    default="tpu-v5-lite-podslice",
+    envvar=f"{PREFIX}_TPU_ACCELERATOR_TYPE",
+)
+@click.option(
+    "--tpu-topology",
+    type=str,
+    default="2x4",
+    envvar=f"{PREFIX}_TPU_TOPOLOGY",
+)
+@click.option(
+    "--tpu-chips-per-worker",
+    type=int,
+    default=8,
+    envvar=f"{PREFIX}_TPU_CHIPS_PER_WORKER",
+)
+@click.option(
+    "--server-replicas",
+    type=int,
+    default=2,
+    envvar=f"{PREFIX}_SERVER_REPLICAS",
+)
+@click.option(
+    "--server-workers", type=int, default=2, envvar=f"{PREFIX}_SERVER_WORKERS"
+)
+@click.option(
+    "--ml-server-hpa-type",
+    type=click.Choice(["cpu", "keda"]),
+    default="cpu",
+    envvar=f"{PREFIX}_ML_SERVER_HPA_TYPE",
+)
+@click.option(
+    "--ml-server-max-replicas",
+    type=int,
+    default=None,
+    envvar=f"{PREFIX}_ML_SERVER_MAX_REPLICAS",
+    help="Default: 10 x number of machines",
+)
+@click.option(
+    "--ml-server-min-replicas",
+    type=int,
+    default=1,
+    envvar=f"{PREFIX}_ML_SERVER_MIN_REPLICAS",
+)
+@click.option(
+    "--ml-server-hpa-cpu-target",
+    type=int,
+    default=50,
+    envvar=f"{PREFIX}_ML_SERVER_HPA_CPU_TARGET",
+)
+@click.option(
+    "--prometheus-server-address",
+    type=str,
+    default="http://prometheus:9090",
+    envvar=f"{PREFIX}_PROMETHEUS_SERVER_ADDRESS",
+)
+@click.option(
+    "--keda-threshold",
+    type=str,
+    default="10",
+    envvar=f"{PREFIX}_KEDA_THRESHOLD",
+)
+@click.option(
+    "--resource-labels",
+    type=key_value_par,
+    multiple=True,
+    envvar=f"{PREFIX}_RESOURCE_LABELS",
+    help="Key,value labels added to all resources; repeatable",
+)
+@click.option(
+    "--custom-model-builder-envs",
+    type=str,
+    default="",
+    envvar=f"{PREFIX}_CUSTOM_MODEL_BUILDER_ENVS",
+    help="JSON list of k8s EnvVar dicts for builder pods",
+)
+@click.option(
+    "--owner-references",
+    type=str,
+    default=None,
+    envvar=f"{PREFIX}_OWNER_REFERENCES",
+    help="JSON/YAML list of k8s ownerReferences for the workflow",
+)
+@click.option(
+    "--storage-claim-name",
+    type=str,
+    default="gordo-storage",
+    envvar=f"{PREFIX}_STORAGE_CLAIM_NAME",
+)
+@click.option(
+    "--service-account",
+    type=str,
+    default="gordo-tpu",
+    envvar=f"{PREFIX}_SERVICE_ACCOUNT",
+)
+@click.option(
+    "--deadline-seconds",
+    type=int,
+    default=86400,
+    envvar=f"{PREFIX}_DEADLINE_SECONDS",
+)
+@click.option(
+    "--enable-clients/--disable-clients",
+    default=True,
+    envvar=f"{PREFIX}_ENABLE_CLIENTS",
+    help="Render prediction-client tasks into the DAG",
+)
+@click.option(
+    "--client-start-date",
+    type=str,
+    default="",
+    envvar=f"{PREFIX}_CLIENT_START_DATE",
+)
+@click.option(
+    "--client-end-date",
+    type=str,
+    default="",
+    envvar=f"{PREFIX}_CLIENT_END_DATE",
+)
+@click.option(
+    "--split-workflows",
+    type=int,
+    default=30,
+    envvar=f"{PREFIX}_SPLIT_WORKFLOWS",
+    help="Split the config into multiple Workflow docs of at most this many "
+    "machines each (0 disables splitting)",
+)
+@click.option(
+    "--exceptions-report-level",
+    type=str,
+    default="MESSAGE",
+    envvar=f"{PREFIX}_EXCEPTIONS_REPORT_LEVEL",
+)
+@click.option(
+    "--postgres-host",
+    type=str,
+    default=None,
+    envvar=f"{PREFIX}_POSTGRES_HOST",
+    help="If set, a PostgresReporter pointed here is appended to every "
+    "machine runtime",
+)
+@click.option(
+    "--spot-tolerations/--no-spot-tolerations",
+    default=True,
+    envvar=f"{PREFIX}_SPOT_TOLERATIONS",
+)
+def workflow_generate_cli(**kwargs):
+    """Generate workflow documents for a machine config."""
+    content = generate_workflow_docs(**kwargs)
+    output_file = kwargs.get("output_file")
+    if output_file:
+        with open(output_file, "w") as f:
+            f.write(content)
+    else:
+        click.echo(content)
+
+
+def _parse_custom_envs(raw: str) -> List[dict]:
+    if not raw:
+        return []
+    try:
+        envs = json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise click.ClickException(
+            f"--custom-model-builder-envs is not valid JSON: {exc}"
+        )
+    if not isinstance(envs, list):
+        raise click.ClickException(
+            "--custom-model-builder-envs must be a JSON list"
+        )
+    for env in envs:
+        if not isinstance(env, dict) or "name" not in env:
+            raise click.ClickException(f"invalid EnvVar entry: {env!r}")
+        if "value" not in env and "valueFrom" not in env:
+            raise click.ClickException(
+                f"EnvVar entry {env['name']!r} needs 'value' or 'valueFrom'"
+            )
+    return envs
+
+
+def generate_workflow_docs(
+    machine_config: str,
+    project_name: str,
+    project_revision: str = "1",
+    workflow_template: Optional[str] = None,
+    docker_registry: str = "ghcr.io/gordo-tpu",
+    docker_image: str = "gordo-tpu",
+    gordo_version: str = __version__,
+    image_pull_policy: str = "",
+    retries: int = 5,
+    machines_per_tpu_worker: int = 256,
+    tpu_accelerator_type: str = "tpu-v5-lite-podslice",
+    tpu_topology: str = "2x4",
+    tpu_chips_per_worker: int = 8,
+    server_replicas: int = 2,
+    server_workers: int = 2,
+    ml_server_hpa_type: str = "cpu",
+    ml_server_max_replicas: Optional[int] = None,
+    ml_server_min_replicas: int = 1,
+    ml_server_hpa_cpu_target: int = 50,
+    prometheus_server_address: str = "http://prometheus:9090",
+    keda_threshold: str = "10",
+    resource_labels: tuple = (),
+    custom_model_builder_envs: str = "",
+    owner_references: Optional[str] = None,
+    storage_claim_name: str = "gordo-storage",
+    service_account: str = "gordo-tpu",
+    deadline_seconds: int = 86400,
+    enable_clients: bool = True,
+    client_start_date: str = "",
+    client_end_date: str = "",
+    split_workflows: int = 30,
+    exceptions_report_level: str = "MESSAGE",
+    postgres_host: Optional[str] = None,
+    spot_tolerations: bool = True,
+    output_file: Optional[str] = None,
+) -> str:
+    """Render one or more Workflow documents (joined by '---') as a string."""
+    if not str(project_revision).isdigit():
+        raise click.ClickException(
+            f"--project-revision must be numeric, got {project_revision!r} "
+            "(it is ordered numerically by the single-workflow guard)"
+        )
+    config = get_dict_from_yaml(machine_config)
+    norm = NormalizedConfig(config, project_name=project_name)
+
+    if postgres_host:
+        for machine in norm.machines:
+            reporters = machine.runtime.setdefault("reporters", [])
+            reporters.append(
+                {
+                    "gordo_tpu.reporters.postgres.PostgresReporter": {
+                        "host": postgres_host
+                    }
+                }
+            )
+
+    tag = sanitize_docker_tag(str(gordo_version))
+    image = f"{docker_registry}/{docker_image}:{tag}"
+    pull_policy = image_pull_policy or default_image_pull_policy(tag)
+
+    owner_refs = None
+    if owner_references:
+        owner_refs = validate_generate_owner_ref(
+            yaml.safe_load(owner_references)
+        )
+
+    template = load_workflow_template(workflow_template)
+
+    # split the full machine list into per-Workflow groups, then bucket each
+    # group into batched TPU builder chunks
+    if split_workflows and split_workflows > 0:
+        workflow_groups = chunk_machines(norm.machines, split_workflows)
+    else:
+        workflow_groups = [list(norm.machines)]
+
+    docs: List[str] = []
+    for group_idx, group in enumerate(workflow_groups):
+        chunks = chunk_machines(group, machines_per_tpu_worker)
+        builder_chunks = []
+        machine_ctx: List[Dict[str, Any]] = []
+        for chunk_idx, chunk in enumerate(chunks):
+            chunk_id = f"g{group_idx}c{chunk_idx}"
+            builder_chunks.append(
+                {
+                    "id": chunk_id,
+                    "machine_names": [m.name for m in chunk],
+                    "n_machines": len(chunk),
+                }
+            )
+            for m in chunk:
+                machine_ctx.append(
+                    {
+                        "name": m.name,
+                        "chunk_task": f"tpu-batch-builder-{chunk_id}",
+                    }
+                )
+        # the full group config is staged onto shared storage by the
+        # stage-config task; chunk tasks only carry machine names
+        group_config = {"machines": [m.to_dict() for m in group]}
+        staged_config_path = (
+            f"/gordo/config/{project_name}/{project_revision}/"
+            f"group-{group_idx}.yaml"
+        )
+
+        max_replicas = (
+            ml_server_max_replicas
+            if ml_server_max_replicas is not None
+            else 10 * len(group)
+        )
+        context = {
+            "project_name": project_name,
+            "project_revision": project_revision,
+            "project_version": __version__,
+            "labels": dict(resource_labels),
+            "owner_references": owner_refs,
+            "image": image,
+            "image_pull_policy": pull_policy,
+            "builder_retries": retries,
+            "builder_chunks": builder_chunks,
+            "group_config": group_config,
+            "staged_config_path": staged_config_path,
+            "machines": machine_ctx,
+            "enable_clients": enable_clients,
+            "client_start_date": client_start_date,
+            "client_end_date": client_end_date,
+            "client_max_instances": norm.globals["runtime"]["client"][
+                "max_instances"
+            ],
+            "tpu": {
+                "accelerator_type": tpu_accelerator_type,
+                "topology": tpu_topology,
+                "chips_per_worker": tpu_chips_per_worker,
+                "jax_platforms": "tpu",
+            },
+            "builder_resources": norm.globals["runtime"]["builder"][
+                "resources"
+            ],
+            "server_resources": norm.globals["runtime"]["server"]["resources"],
+            "client_resources": norm.globals["runtime"]["client"]["resources"],
+            "server_replicas": server_replicas,
+            "server_workers": server_workers,
+            "ml_server_hpa": {
+                "type": ml_server_hpa_type,
+                "min_replicas": ml_server_min_replicas,
+                "max_replicas": max_replicas,
+                "cpu_target": ml_server_hpa_cpu_target,
+                "cooldown": 300,
+                "prometheus_server_address": prometheus_server_address,
+                "keda_query": (
+                    "sum(rate(gordo_server_requests_total{project="
+                    f'"{project_name}"'
+                    "}[1m]))"
+                ),
+                "keda_threshold": keda_threshold,
+            },
+            "storage_claim_name": storage_claim_name,
+            "service_account": service_account,
+            "deadline_seconds": deadline_seconds,
+            "exceptions_report_level": exceptions_report_level,
+            "custom_builder_envs": _parse_custom_envs(
+                custom_model_builder_envs
+            ),
+            "spot_tolerations": spot_tolerations,
+        }
+        docs.append(template.render(**context))
+
+    return "\n---\n".join(docs) + "\n"

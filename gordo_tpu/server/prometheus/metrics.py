@@ -7,7 +7,6 @@ gordo_name, project), plus a version-info gauge. Multiprocess registry
 supported via the standard prometheus_client env var.
 """
 
-import contextlib
 import os
 import re
 import timeit
@@ -43,34 +42,40 @@ class GordoServerPrometheusMetrics:
     ):
         self.project = project or "unknown"
         self.registry = registry if registry is not None else create_registry()
+        # In multiprocess mode the exposition registry must contain ONLY the
+        # MultiProcessCollector (it reads every worker's mmap files);
+        # registering the live metric objects there too would double-count.
+        # Metric values still land in the mmap files regardless of registry.
+        multiproc = (
+            "PROMETHEUS_MULTIPROC_DIR" in os.environ
+            or "prometheus_multiproc_dir" in os.environ
+        )
+        metric_registry = None if multiproc else self.registry
         self.request_duration = Histogram(
             "gordo_server_request_duration_seconds",
             "HTTP request duration",
             ["method", "path", "status_code", "gordo_name", "project"],
-            registry=self.registry,
+            registry=metric_registry,
         )
         self.request_count = Counter(
             "gordo_server_requests_total",
             "HTTP request count",
             ["method", "path", "status_code", "gordo_name", "project"],
-            registry=self.registry,
+            registry=metric_registry,
         )
         self.version_info = Gauge(
             "gordo_server_info",
             "Server version info",
             ["version", "project"],
-            registry=self.registry,
+            registry=metric_registry,
         )
         self.version_info.labels(version=__version__, project=self.project).set(1)
-        self._start = None
 
-    @contextlib.contextmanager
-    def observe(self, request):
-        self._start = timeit.default_timer()
-        yield
-
-    def record(self, request, response):
-        duration = timeit.default_timer() - (self._start or timeit.default_timer())
+    def record(self, request, response, start_time: float):
+        """Record one request; ``start_time`` is the caller's local
+        ``timeit.default_timer()`` reading at request start (kept per-request
+        so concurrent requests under a threaded server can't race)."""
+        duration = timeit.default_timer() - start_time
         match = _NAME_RE.search(request.path)
         gordo_name = match.group(1) if match else ""
         labels = dict(

@@ -16,6 +16,7 @@ scale; multiple processes can still be run behind any WSGI server).
 import json
 import logging
 import os
+import re
 import timeit
 from typing import Any, Dict, List, Optional
 
@@ -57,6 +58,7 @@ class GordoServer:
         [
             Rule("/healthcheck", endpoint="healthcheck"),
             Rule("/server-version", endpoint="server_version"),
+            Rule("/metrics", endpoint="metrics"),
             Rule(
                 "/gordo/v0/<gordo_project>/models",
                 endpoint="model_list",
@@ -95,7 +97,11 @@ class GordoServer:
         strict_slashes=False,
     )
 
-    def __init__(self, config: Optional[Dict[str, Any]] = None):
+    def __init__(
+        self,
+        config: Optional[Dict[str, Any]] = None,
+        prometheus_registry=None,
+    ):
         self.config = default_config()
         if config:
             self.config.update(config)
@@ -107,8 +113,13 @@ class GordoServer:
             )
 
             self._prometheus = GordoServerPrometheusMetrics(
-                project=self.config.get("PROJECT")
+                project=self.config.get("PROJECT"),
+                registry=prometheus_registry,
             )
+
+    # a revision is a plain directory-name token; anything with path
+    # separators or dot-runs would escape the model collection tree
+    _REVISION_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 
     # ------------------------------------------------------------ dispatch
     def _resolve_revision(self, ctx: RequestContext, request: Request):
@@ -121,7 +132,11 @@ class GordoServer:
         revision = request.args.get("revision") or request.headers.get("revision")
         if revision:
             candidate = os.path.join(collection_dir, "..", revision)
-            if not os.path.isdir(candidate):
+            if (
+                not self._REVISION_RE.match(revision)
+                or ".." in revision
+                or not os.path.isdir(candidate)
+            ):
                 ctx.revision = revision
                 return Response(
                     simplejson.dumps({"error": f"Revision '{revision}' not found."}),
@@ -151,6 +166,14 @@ class GordoServer:
                     response = Response("", status=200)
                 elif endpoint == "server_version":
                     response = views.json_response(ctx, {"version": __version__})
+                elif endpoint == "metrics":
+                    if self._prometheus is None:
+                        response = Response("metrics disabled", status=404)
+                    else:
+                        response = Response(
+                            self._prometheus.expose(),
+                            mimetype="text/plain; version=0.0.4",
+                        )
                 else:
                     handler = getattr(views, endpoint)
                     response = handler(ctx, request, **values)
@@ -173,9 +196,9 @@ class GordoServer:
     def wsgi_app(self, environ, start_response):
         request = Request(environ)
         if self._prometheus is not None:
-            with self._prometheus.observe(request):
-                response = self.dispatch_request(request)
-                self._prometheus.record(request, response)
+            start = timeit.default_timer()
+            response = self.dispatch_request(request)
+            self._prometheus.record(request, response, start)
         else:
             response = self.dispatch_request(request)
         return response(environ, start_response)
@@ -194,10 +217,7 @@ def build_app(
     config: Optional[Dict[str, Any]] = None, prometheus_registry=None
 ) -> GordoServer:
     """Build the WSGI app (reference build_app, server.py:139-231)."""
-    app = GordoServer(config)
-    if prometheus_registry is not None and app._prometheus is not None:
-        app._prometheus.registry = prometheus_registry
-    return app
+    return GordoServer(config, prometheus_registry=prometheus_registry)
 
 
 def run_server(
@@ -209,11 +229,47 @@ def run_server(
 ):
     """
     Serve the app (reference run_server shells out to gunicorn,
-    server.py:233-297; here: threaded werkzeug server — device compute
-    releases the GIL, so threads provide the request concurrency).
-    """
-    from werkzeug.serving import run_simple
+    server.py:233-297; here: a prefork pool of threaded werkzeug servers).
 
+    The listening socket is bound once and inherited by ``workers`` forked
+    processes that all accept on it; each worker serves threaded (device
+    compute releases the GIL, so threads provide request concurrency on one
+    warm model cache per worker). With prometheus enabled and workers > 1,
+    PROMETHEUS_MULTIPROC_DIR is set before the per-worker app build so
+    /metrics aggregates across the pool. ``worker_connections`` is accepted
+    for reference-CLI parity; the werkzeug server has no connection cap.
+    """
+    import socket
+    import tempfile
+
+    from werkzeug.serving import make_server
+
+    workers = max(1, workers)
+    if (
+        workers > 1
+        and default_config()["ENABLE_PROMETHEUS"]
+        and "PROMETHEUS_MULTIPROC_DIR" not in os.environ
+    ):
+        os.environ["PROMETHEUS_MULTIPROC_DIR"] = tempfile.mkdtemp(
+            prefix="gordo-prometheus-"
+        )
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(max(128, worker_connections))
+
+    logger.info(
+        "Starting server on %s:%s with %d worker(s)", host, port, workers
+    )
+    for _ in range(workers - 1):
+        if os.fork() == 0:
+            break  # child: fall through to serve on the inherited socket
+
+    # app built per worker process: model cache and metric values are
+    # process-local (metrics aggregate via the multiprocess dir)
     app = build_app()
-    logger.info("Starting server on %s:%s", host, port)
-    run_simple(host, port, app, threaded=True, processes=1)
+    server = make_server(
+        host, port, app, threaded=True, fd=sock.fileno()
+    )
+    server.serve_forever()

@@ -1,0 +1,157 @@
+"""
+Workflow-generation helpers: YAML loading, jinja2 environment, image policy.
+
+Reference parity: gordo/workflow/workflow_generator/workflow_generator.py
+(:62-99 tz-enforcing YAML timestamp loading + Gordo CRD unwrap, :109-126
+jinja2 env with a ``yaml`` filter and StrictUndefined, :129-137 image pull
+policy selection) and :23-58 owner-reference validation. Re-designed around a
+TPU-first template: machines are grouped into batched TPU builder chunks
+instead of one pod per machine.
+"""
+
+import logging
+import os
+from datetime import datetime, timezone
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import jinja2
+import yaml
+
+logger = logging.getLogger(__name__)
+
+_TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "resources")
+DEFAULT_TEMPLATE = "tpu-workflow.yml.template"
+
+
+class TimestampNotTZAware(ValueError):
+    """A YAML timestamp in the config has no timezone information."""
+
+
+def _tz_aware_timestamp_constructor(loader, node):
+    value = loader.construct_yaml_timestamp(node)
+    if isinstance(value, datetime) and value.tzinfo is None:
+        raise TimestampNotTZAware(
+            f"Provide timezone to timestamp {node.value!r} "
+            "(e.g. '2019-01-01T00:00:00Z')"
+        )
+    return value
+
+
+class _TZAwareSafeLoader(yaml.SafeLoader):
+    pass
+
+
+_TZAwareSafeLoader.add_constructor(
+    "tag:yaml.org,2002:timestamp", _tz_aware_timestamp_constructor
+)
+
+
+def get_dict_from_yaml(config: Union[str, "os.PathLike", Any]) -> dict:
+    """
+    Load a config into a dict, enforcing tz-aware timestamps.
+
+    Accepts a path, a file object, or a raw YAML string. If the document is a
+    ``kind: Gordo`` CRD, unwrap ``spec.config`` (reference
+    workflow_generator.py:96-98).
+    """
+    if hasattr(config, "read"):
+        content = config.read()
+    elif isinstance(config, (str, os.PathLike)) and os.path.isfile(
+        str(config)
+    ):
+        with open(config) as f:
+            content = f.read()
+    else:
+        content = str(config)
+    try:
+        doc = yaml.load(content, Loader=_TZAwareSafeLoader)
+    except TimestampNotTZAware:
+        raise
+    except yaml.YAMLError as exc:
+        raise ValueError(f"Invalid config YAML: {exc}") from exc
+    if not isinstance(doc, dict):
+        raise ValueError("Config must be a YAML mapping")
+    if str(doc.get("kind", "")).lower() == "gordo":
+        doc = doc.get("spec", {}).get("config", {})
+        if not isinstance(doc, dict):
+            raise ValueError("Gordo CRD has no spec.config mapping")
+    return doc
+
+
+def _yaml_filter(value: Any, indent: int = 0) -> str:
+    """Jinja filter: dump a value as YAML, optionally indenting every line."""
+    dumped = yaml.safe_dump(value, default_flow_style=False).rstrip("\n")
+    if indent:
+        pad = " " * indent
+        dumped = "\n".join(pad + line for line in dumped.splitlines())
+    return dumped
+
+
+def load_workflow_template(template_path: Optional[str] = None) -> jinja2.Template:
+    """jinja2 template with StrictUndefined and yaml/tojson filters."""
+    if template_path is None:
+        template_path = os.path.join(_TEMPLATE_DIR, DEFAULT_TEMPLATE)
+    directory, name = os.path.split(template_path)
+    env = jinja2.Environment(
+        loader=jinja2.FileSystemLoader(directory or "."),
+        undefined=jinja2.StrictUndefined,
+        trim_blocks=True,
+        lstrip_blocks=True,
+    )
+    env.filters["yaml"] = _yaml_filter
+    return env.get_template(name)
+
+
+def default_image_pull_policy(tag: str) -> str:
+    """'Always' for mutable tags (latest/stable/pr-*), else 'IfNotPresent'."""
+    if tag in ("latest", "stable") or tag.startswith("pr-"):
+        return "Always"
+    return "IfNotPresent"
+
+
+_DOCKER_TAG_ALLOWED = set(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
+)
+
+
+def sanitize_docker_tag(tag: str, max_len: int = 128) -> str:
+    """Replace characters docker tags disallow and clamp the length."""
+    cleaned = "".join(c if c in _DOCKER_TAG_ALLOWED else "-" for c in tag)
+    return cleaned.lstrip(".-")[:max_len] or "latest"
+
+
+def validate_generate_owner_ref(owner_ref: Any) -> List[dict]:
+    """
+    Validate a list of k8s ownerReferences (reference
+    workflow_generator.py:23-58): each must carry the four required keys.
+    """
+    if not isinstance(owner_ref, list) or not owner_ref:
+        raise TypeError("owner-references must be a non-empty list")
+    required = {"uid", "name", "kind", "apiVersion"}
+    for ref in owner_ref:
+        if not isinstance(ref, dict) or not required.issubset(ref):
+            raise TypeError(
+                f"owner-reference {ref!r} missing keys "
+                f"{sorted(required - set(ref or {}))}"
+            )
+    return owner_ref
+
+
+def chunk_machines(machines: Iterable[Any], chunk_size: int) -> List[List[Any]]:
+    """Split machines into batched-builder chunks of at most ``chunk_size``."""
+    if chunk_size < 1:
+        raise ValueError("chunk_size must be >= 1")
+    out: List[List[Any]] = []
+    bucket: List[Any] = []
+    for machine in machines:
+        bucket.append(machine)
+        if len(bucket) == chunk_size:
+            out.append(bucket)
+            bucket = []
+    if bucket:
+        out.append(bucket)
+    return out
+
+
+def utc_now_iso() -> str:
+    return datetime.now(timezone.utc).isoformat()

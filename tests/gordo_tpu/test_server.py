@@ -204,3 +204,35 @@ def test_prometheus_metrics(model_collection_directory):
     body = app._prometheus.expose().decode()
     assert "gordo_server_requests_total" in body
     assert 'project="test-proj"' in body
+    # metrics are reachable over HTTP, not just collected
+    resp = client.get("/metrics")
+    assert resp.status_code == 200
+    assert "gordo_server_requests_total" in resp.get_data(as_text=True)
+
+
+def test_prometheus_custom_registry(model_collection_directory):
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    registry = CollectorRegistry()
+    app = build_app(
+        {
+            "MODEL_COLLECTION_DIR": model_collection_directory,
+            "ENABLE_PROMETHEUS": True,
+            "PROJECT": "test-proj",
+        },
+        prometheus_registry=registry,
+    )
+    app.test_client().get("/healthcheck")
+    # collectors registered in the caller-supplied registry
+    assert b"gordo_server_requests_total" in generate_latest(registry)
+
+
+def test_metrics_404_when_disabled(client):
+    assert client.get("/metrics").status_code == 404
+
+
+def test_revision_traversal_rejected(client, gordo_project):
+    # path separators / dot-runs in ?revision= must not escape the tree
+    for bad in ("../../../../etc", "..", "a/b", "foo%2F..%2Fbar"):
+        resp = client.get(f"/gordo/v0/{gordo_project}/models?revision={bad}")
+        assert resp.status_code == 410, bad

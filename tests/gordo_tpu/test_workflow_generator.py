@@ -1,0 +1,254 @@
+"""
+Workflow-generator tests.
+
+Mirrors the reference's strategy (SURVEY.md §4): render the template through
+the CLI and assert on the PARSED YAML structure — no cluster required
+(reference tests/gordo/workflow/test_workflow_generator/
+test_workflow_generator.py:37-77).
+"""
+
+import json
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli.cli import gordo
+from gordo_tpu.cli.workflow_generator import generate_workflow_docs
+from gordo_tpu.workflow.workflow_generator import (
+    TimestampNotTZAware,
+    chunk_machines,
+    default_image_pull_policy,
+    get_dict_from_yaml,
+    sanitize_docker_tag,
+    validate_generate_owner_ref,
+)
+
+
+def _config_yaml(n_machines=3) -> str:
+    machines = []
+    for i in range(n_machines):
+        machines.append(
+            {
+                "name": f"machine-{i}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tags": [f"tag-{i}-{j}" for j in range(4)],
+                    "train_start_date": "2019-01-01T00:00:00+00:00",
+                    "train_end_date": "2019-01-08T00:00:00+00:00",
+                },
+                "model": {
+                    "gordo_tpu.models.models.AutoEncoder": {
+                        "kind": "feedforward_hourglass"
+                    }
+                },
+            }
+        )
+    return yaml.safe_dump({"machines": machines})
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "config.yml"
+    p.write_text(_config_yaml())
+    return str(p)
+
+
+def _render(config_file, **overrides) -> list:
+    content = generate_workflow_docs(
+        machine_config=config_file, project_name="test-proj", **overrides
+    )
+    return [d for d in yaml.safe_load_all(content) if d]
+
+
+def test_generate_renders_valid_workflow_yaml(config_file):
+    docs = _render(config_file)
+    assert len(docs) == 1
+    wf = docs[0]
+    assert wf["kind"] == "Workflow"
+    assert wf["metadata"]["generateName"] == "gordo-tpu-test-proj-"
+    labels = wf["metadata"]["labels"]
+    assert labels["applications.gordo.equinor.com/project-name"] == "test-proj"
+    template_names = {t["name"] for t in wf["spec"]["templates"]}
+    assert {
+        "ensure-single-workflow",
+        "tpu-batch-builder",
+        "gordo-server-deployment",
+        "gordo-client",
+        "workflow-cleanup",
+        "do-all",
+    } <= template_names
+
+
+def test_generate_batches_machines_into_chunks(config_file):
+    wf = _render(config_file, machines_per_tpu_worker=2)[0]
+    dag = next(
+        t for t in wf["spec"]["templates"] if t["name"] == "do-all"
+    )["dag"]
+    builder_tasks = [
+        t for t in dag["tasks"] if t["name"].startswith("tpu-batch-builder-")
+    ]
+    # 3 machines, 2 per chunk => 2 chunks (not 3 per-machine pods)
+    assert len(builder_tasks) == 2
+    # chunk tasks carry only machine names (full config is staged onto the
+    # PVC by stage-config, keeping parameters tiny)
+    names_param = builder_tasks[0]["arguments"]["parameters"][1]
+    assert names_param["name"] == "machine-names"
+    assert names_param["value"] == "machine-0,machine-1"
+    assert builder_tasks[0]["dependencies"] == ["stage-config"]
+
+
+def _staged_config(wf: dict) -> dict:
+    """Extract the YAML embedded in the stage-config heredoc."""
+    stage = next(
+        t for t in wf["spec"]["templates"] if t["name"] == "stage-config"
+    )
+    source = stage["script"]["source"]
+    start = source.index("\n", source.index("GORDO_TPU_CONFIG_EOF")) + 1
+    end = source.rindex("GORDO_TPU_CONFIG_EOF")
+    return yaml.safe_load(source[start:end])
+
+
+def test_generate_stage_config_contains_full_machines(config_file):
+    wf = _render(config_file)[0]
+    # the heredoc embeds the full group config incl. model definitions
+    staged = _staged_config(wf)
+    assert len(staged["machines"]) == 3
+    assert "model" in staged["machines"][0]
+    assert staged["machines"][0]["name"] == "machine-0"
+
+
+def test_generate_client_tasks_depend_on_chunk(config_file):
+    wf = _render(config_file, machines_per_tpu_worker=2)[0]
+    dag = next(
+        t for t in wf["spec"]["templates"] if t["name"] == "do-all"
+    )["dag"]
+    tasks = {t["name"]: t for t in dag["tasks"]}
+    assert "client-machine-2" in tasks
+    deps = tasks["client-wait-machine-2"]["dependencies"]
+    assert "tpu-batch-builder-g0c1" in deps
+
+
+def test_generate_split_workflows(tmp_path):
+    p = tmp_path / "big.yml"
+    p.write_text(_config_yaml(n_machines=7))
+    docs = _render(str(p), split_workflows=3)
+    assert len(docs) == 3  # 3 + 3 + 1 machines
+
+
+def test_generate_keda_autoscaler(config_file):
+    wf = _render(config_file, ml_server_hpa_type="keda")[0]
+    scaler = next(
+        t
+        for t in wf["spec"]["templates"]
+        if t["name"] == "gordo-server-autoscaler"
+    )
+    manifest = yaml.safe_load(scaler["resource"]["manifest"])
+    assert manifest["kind"] == "ScaledObject"
+    assert manifest["spec"]["triggers"][0]["type"] == "prometheus"
+
+
+def test_generate_hpa_default_max_replicas(config_file):
+    wf = _render(config_file)[0]
+    scaler = next(
+        t
+        for t in wf["spec"]["templates"]
+        if t["name"] == "gordo-server-autoscaler"
+    )
+    manifest = yaml.safe_load(scaler["resource"]["manifest"])
+    assert manifest["kind"] == "HorizontalPodAutoscaler"
+    assert manifest["spec"]["maxReplicas"] == 30  # 10 x 3 machines
+
+
+def test_generate_custom_builder_envs(config_file):
+    envs = json.dumps([{"name": "FOO", "value": "bar"}])
+    wf = _render(config_file, custom_model_builder_envs=envs)[0]
+    builder = next(
+        t for t in wf["spec"]["templates"] if t["name"] == "tpu-batch-builder"
+    )
+    env_names = [e["name"] for e in builder["container"]["env"]]
+    assert "FOO" in env_names
+
+
+def test_generate_postgres_reporter_injection(config_file):
+    wf = _render(config_file, postgres_host="pg.example.com")[0]
+    staged = _staged_config(wf)
+    reporters = staged["machines"][0]["runtime"]["reporters"]
+    assert any("PostgresReporter" in str(r) for r in reporters)
+
+
+def test_generate_custom_env_valuefrom(config_file):
+    envs = json.dumps(
+        [
+            {
+                "name": "POD_IP",
+                "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+            }
+        ]
+    )
+    wf = _render(config_file, custom_model_builder_envs=envs)[0]
+    builder = next(
+        t for t in wf["spec"]["templates"] if t["name"] == "tpu-batch-builder"
+    )
+    pod_ip = next(
+        e for e in builder["container"]["env"] if e["name"] == "POD_IP"
+    )
+    assert pod_ip["valueFrom"]["fieldRef"]["fieldPath"] == "status.podIP"
+
+
+def test_generate_via_cli(config_file, tmp_path):
+    out = tmp_path / "wf.yml"
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_file,
+            "--project-name",
+            "cli-proj",
+            "--output-file",
+            str(out),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    docs = list(yaml.safe_load_all(out.read_text()))
+    assert docs[0]["kind"] == "Workflow"
+
+
+def test_owner_references_validation():
+    with pytest.raises(TypeError):
+        validate_generate_owner_ref([{"name": "x"}])
+    good = [
+        {"uid": "1", "name": "x", "kind": "Deployment", "apiVersion": "v1"}
+    ]
+    assert validate_generate_owner_ref(good) == good
+
+
+def test_tz_naive_timestamp_rejected(tmp_path):
+    p = tmp_path / "bad.yml"
+    p.write_text("machines: []\nstart: 2019-01-01 00:00:00\n")
+    with pytest.raises(TimestampNotTZAware):
+        get_dict_from_yaml(str(p))
+
+
+def test_gordo_crd_unwrap():
+    doc = yaml.safe_dump(
+        {"kind": "Gordo", "spec": {"config": {"machines": []}}}
+    )
+    assert get_dict_from_yaml(doc) == {"machines": []}
+
+
+def test_image_pull_policy_and_tag():
+    assert default_image_pull_policy("latest") == "Always"
+    assert default_image_pull_policy("1.2.3") == "IfNotPresent"
+    assert default_image_pull_policy("pr-12") == "Always"
+    assert sanitize_docker_tag("feature/x y") == "feature-x-y"
+
+
+def test_chunk_machines():
+    assert chunk_machines(list(range(5)), 2) == [[0, 1], [2, 3], [4]]
+    assert chunk_machines([], 3) == []
+    with pytest.raises(ValueError):
+        chunk_machines([1], 0)
