@@ -1,0 +1,15 @@
+"""
+Prediction client for the gordo-tpu model server.
+
+Reference parity: the external ``gordo-client==4.0.0`` package the reference
+depends on (requirements/requirements.in:31; exercised by
+tests/gordo/client/test_client.py and deployed as workflow pods,
+argo-workflow.yml.template:1322-1345): ``Client.predict`` over a date range,
+``get_metadata``, ``download_model``, revision awareness, prediction
+forwarders.
+"""
+
+from .client import Client
+from .utils import PredictionResult
+
+__all__ = ["Client", "PredictionResult"]

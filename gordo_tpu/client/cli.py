@@ -1,0 +1,112 @@
+"""
+``gordo-tpu-client`` CLI.
+
+Reference parity: gordo-client's CLI as invoked by the workflow template
+(`gordo-client --project=.. --host=.. predict <start> <end> --target=..`,
+argo-workflow.yml.template:1322-1345): predict / metadata / download-model
+subcommands.
+"""
+
+import json
+import logging
+import sys
+
+import click
+
+from .client import Client
+from .forwarders import ForwardPredictionsToDisk
+
+logger = logging.getLogger(__name__)
+
+
+@click.group("gordo-tpu-client")
+@click.option("--project", required=True, envvar="GORDO_PROJECT")
+@click.option("--host", default="localhost", envvar="GORDO_HOST")
+@click.option("--port", default=443, type=int, envvar="GORDO_PORT")
+@click.option("--scheme", default="https", envvar="GORDO_SCHEME")
+@click.option("--revision", default=None, envvar="GORDO_REVISION")
+@click.option("--batch-size", default=100000, type=int, envvar="GORDO_BATCH_SIZE")
+@click.option("--parallelism", default=10, type=int, envvar="GORDO_PARALLELISM")
+@click.pass_context
+def gordo_client(ctx, project, host, port, scheme, revision, batch_size, parallelism):
+    """Client for gordo-tpu model servers."""
+    ctx.obj = {
+        "project": project,
+        "host": host,
+        "port": port,
+        "scheme": scheme,
+        "revision": revision,
+        "batch_size": batch_size,
+        "parallelism": parallelism,
+    }
+
+
+def _client(ctx, **extra) -> Client:
+    return Client(**{**ctx.obj, **extra})
+
+
+@gordo_client.command("predict")
+@click.argument("start")
+@click.argument("end")
+@click.option("--target", multiple=True, help="Machine name; repeatable (default: all)")
+@click.option(
+    "--output-dir",
+    default=None,
+    help="Forward prediction batches as parquet files under this directory",
+)
+@click.pass_context
+def predict(ctx, start, end, target, output_dir):
+    """Predict the time range [START, END] for the target machines."""
+    forwarder = (
+        ForwardPredictionsToDisk(output_dir) if output_dir else None
+    )
+    client = _client(ctx, prediction_forwarder=forwarder)
+    results = client.predict(start, end, targets=list(target) or None)
+    failed = False
+    for result in results:
+        n = len(result.predictions) if result.predictions is not None else 0
+        click.echo(f"{result.name}: {n} rows, {len(result.error_messages)} errors")
+        for msg in result.error_messages:
+            failed = True
+            click.echo(f"  error: {msg}", err=True)
+    if failed:
+        sys.exit(1)
+
+
+@gordo_client.command("metadata")
+@click.option("--target", multiple=True)
+@click.option("--output-file", default=None)
+@click.pass_context
+def metadata(ctx, target, output_file):
+    """Fetch metadata for the target machines as JSON."""
+    client = _client(ctx)
+    meta = client.get_metadata(targets=list(target) or None)
+    content = json.dumps(meta, indent=2, default=str)
+    if output_file:
+        with open(output_file, "w") as f:
+            f.write(content)
+    else:
+        click.echo(content)
+
+
+@gordo_client.command("download-model")
+@click.argument("output-dir")
+@click.option("--target", multiple=True)
+@click.pass_context
+def download_model(ctx, output_dir, target):
+    """Download and save models into OUTPUT_DIR/<machine>/."""
+    import os
+
+    from gordo_tpu import serializer
+
+    client = _client(ctx)
+    models = client.download_model(targets=list(target) or None)
+    for name, model in models.items():
+        model_dir = os.path.join(output_dir, name)
+        os.makedirs(model_dir, exist_ok=True)
+        serializer.dump(model, model_dir)
+        click.echo(f"saved: {name} -> {model_dir}")
+
+
+if __name__ == "__main__":
+    gordo_client()

@@ -1,0 +1,292 @@
+"""
+The prediction client.
+
+Reference parity: gordo-client's ``Client`` (used in
+tests/gordo/client/test_client.py:16-72 and by the workflow's client pods):
+predict over a date range for some/all machines of a project, get metadata,
+download models, revision handling. TPU-era behavioral notes: batches are
+POSTed as snappy-parquet by default (cheapest decode server-side), and
+per-machine prediction fans out over a thread pool (requests are I/O-bound;
+the server batches compute on device).
+"""
+
+import concurrent.futures
+import logging
+from datetime import datetime
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import pandas as pd
+
+from gordo_tpu import serializer
+from gordo_tpu.dataset import GordoBaseDataset
+from gordo_tpu.server import utils as server_utils
+from .io import NotFound, _handle_response
+from .utils import PredictionResult
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """Query a gordo-tpu model server for predictions and artifacts."""
+
+    def __init__(
+        self,
+        project: str,
+        host: str = "localhost",
+        port: int = 443,
+        scheme: str = "https",
+        revision: Optional[str] = None,
+        prediction_forwarder: Optional[
+            Callable[[pd.DataFrame, Any, dict], None]
+        ] = None,
+        batch_size: int = 100000,
+        parallelism: int = 10,
+        n_retries: int = 5,
+        use_parquet: bool = True,
+        data_provider: Optional[Any] = None,
+        session: Optional[Any] = None,
+    ):
+        self.project_name = project
+        self.base_url = f"{scheme}://{host}:{port}/gordo/v0/{project}"
+        self.revision = revision
+        self.prediction_forwarder = prediction_forwarder
+        self.batch_size = batch_size
+        self.parallelism = max(1, parallelism)
+        self.use_parquet = use_parquet
+        self.data_provider = data_provider
+        if session is None:
+            import requests
+            from requests.adapters import HTTPAdapter, Retry
+
+            session = requests.Session()
+            retry = Retry(
+                total=n_retries,
+                backoff_factor=0.5,
+                status_forcelist=(500, 502, 503, 504),
+                allowed_methods=("GET", "POST"),
+            )
+            session.mount("http://", HTTPAdapter(max_retries=retry))
+            session.mount("https://", HTTPAdapter(max_retries=retry))
+        self.session = session
+        # machines whose model is not an anomaly detector fall back to the
+        # base prediction endpoint (detected on first 422, cached per name)
+        self._plain_prediction_machines: set = set()
+
+    # ------------------------------------------------------------- queries
+    def _params(self, revision: Optional[str] = None) -> dict:
+        revision = revision or self.revision
+        return {"revision": revision} if revision else {}
+
+    def get_revisions(self) -> dict:
+        resp = self.session.get(f"{self.base_url}/revisions")
+        return _handle_response(resp, "revisions")
+
+    def get_available_machines(self, revision: Optional[str] = None) -> dict:
+        resp = self.session.get(
+            f"{self.base_url}/models", params=self._params(revision)
+        )
+        return _handle_response(resp, "model list")
+
+    def get_machine_names(self, revision: Optional[str] = None) -> List[str]:
+        return self.get_available_machines(revision).get("models", [])
+
+    def get_metadata(
+        self,
+        revision: Optional[str] = None,
+        targets: Optional[List[str]] = None,
+        _resolved: bool = False,
+    ) -> Dict[str, dict]:
+        """Metadata for every (or the given) machine, keyed by name."""
+        names = (
+            list(targets)
+            if _resolved and targets
+            else self._resolve_targets(targets, revision)
+        )
+        out = {}
+        for name in names:
+            resp = self.session.get(
+                f"{self.base_url}/{name}/metadata",
+                params=self._params(revision),
+            )
+            out[name] = _handle_response(resp, f"metadata for {name}").get(
+                "metadata", {}
+            )
+        return out
+
+    def download_model(
+        self,
+        revision: Optional[str] = None,
+        targets: Optional[List[str]] = None,
+    ) -> Dict[str, Any]:
+        """Download and deserialize models, keyed by machine name."""
+        names = self._resolve_targets(targets, revision)
+        out = {}
+        for name in names:
+            resp = self.session.get(
+                f"{self.base_url}/{name}/download-model",
+                params=self._params(revision),
+            )
+            out[name] = serializer.loads(
+                _handle_response(resp, f"model for {name}")
+            )
+        return out
+
+    def _resolve_targets(
+        self, targets: Optional[List[str]], revision: Optional[str]
+    ) -> List[str]:
+        available = self.get_machine_names(revision)
+        if not targets:
+            return available
+        missing = set(targets) - set(available)
+        if missing:
+            raise NotFound(
+                f"Machines {sorted(missing)} not found in project "
+                f"{self.project_name} (available: {sorted(available)})"
+            )
+        return list(targets)
+
+    # ------------------------------------------------------------- predict
+    def predict(
+        self,
+        start: Union[str, datetime],
+        end: Union[str, datetime],
+        targets: Optional[List[str]] = None,
+        revision: Optional[str] = None,
+    ) -> List[PredictionResult]:
+        """
+        Predict/anomaly-score the given time range for each target machine.
+
+        Data is fetched via each machine's own dataset config (or this
+        client's ``data_provider`` override), POSTed in batches, and the
+        responses concatenated per machine.
+        """
+        names = self._resolve_targets(targets, revision)
+        metadata = self.get_metadata(revision, names, _resolved=True)
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.parallelism
+        ) as pool:
+            futures = {
+                pool.submit(
+                    self.predict_single_machine,
+                    name,
+                    start,
+                    end,
+                    revision,
+                    metadata[name],
+                ): name
+                for name in names
+            }
+            results = []
+            for future in concurrent.futures.as_completed(futures):
+                name = futures[future]
+                try:
+                    results.append(future.result())
+                except Exception as exc:
+                    logger.exception("Prediction failed for %s", name)
+                    results.append(
+                        PredictionResult(name, None, [str(exc)])
+                    )
+        return results
+
+    def predict_single_machine(
+        self,
+        name: str,
+        start: Union[str, datetime],
+        end: Union[str, datetime],
+        revision: Optional[str],
+        metadata: dict,
+    ) -> PredictionResult:
+        X, y = self._get_data(metadata, start, end)
+        frames: List[pd.DataFrame] = []
+        errors: List[str] = []
+        for batch_start in range(0, len(X), self.batch_size):
+            X_batch = X.iloc[batch_start : batch_start + self.batch_size]
+            y_batch = (
+                y.iloc[batch_start : batch_start + self.batch_size]
+                if y is not None
+                else X_batch
+            )
+            try:
+                frame = self._post_prediction(
+                    name, X_batch, y_batch, revision
+                )
+                frames.append(frame)
+                if self.prediction_forwarder is not None:
+                    self.prediction_forwarder(
+                        predictions=frame, machine=name, metadata=metadata
+                    )
+            except Exception as exc:
+                errors.append(f"batch@{batch_start}: {exc}")
+        predictions = (
+            pd.concat(frames).sort_index() if frames else None
+        )
+        return PredictionResult(name, predictions, errors)
+
+    def _get_data(self, metadata: dict, start, end):
+        dataset_config = dict(
+            metadata.get("dataset", {})
+            or metadata.get("build_metadata", {})
+            .get("dataset", {})
+            .get("dataset_meta", {})
+        )
+        dataset_config["train_start_date"] = start
+        dataset_config["train_end_date"] = end
+        if self.data_provider is not None:
+            dataset_config["data_provider"] = self.data_provider
+        dataset = GordoBaseDataset.from_dict(dataset_config)
+        return dataset.get_data()
+
+    def _post_prediction(
+        self,
+        name: str,
+        X: pd.DataFrame,
+        y: Optional[pd.DataFrame],
+        revision: Optional[str],
+    ) -> pd.DataFrame:
+        from .io import HttpUnprocessableEntity
+
+        if name in self._plain_prediction_machines:
+            endpoint = "prediction"
+        else:
+            endpoint = "anomaly/prediction"
+        try:
+            return self._post_to(name, endpoint, X, y, revision)
+        except HttpUnprocessableEntity:
+            if endpoint == "prediction":
+                raise
+            self._plain_prediction_machines.add(name)
+            return self._post_to(name, "prediction", X, y, revision)
+
+    def _post_to(
+        self,
+        name: str,
+        endpoint: str,
+        X: pd.DataFrame,
+        y: Optional[pd.DataFrame],
+        revision: Optional[str],
+    ) -> pd.DataFrame:
+        url = f"{self.base_url}/{name}/{endpoint}"
+        params = dict(self._params(revision), format="parquet") \
+            if self.use_parquet else self._params(revision)
+        if self.use_parquet:
+            import io as _io
+
+            files = {
+                "X": _io.BytesIO(
+                    server_utils.dataframe_into_parquet_bytes(X)
+                ),
+            }
+            if y is not None:
+                files["y"] = _io.BytesIO(
+                    server_utils.dataframe_into_parquet_bytes(y)
+                )
+            resp = self.session.post(url, files=files, params=params)
+        else:
+            payload = {"X": server_utils.dataframe_to_dict(X)}
+            if y is not None:
+                payload["y"] = server_utils.dataframe_to_dict(y)
+            resp = self.session.post(url, json=payload, params=params)
+        content = _handle_response(resp, f"prediction for {name}")
+        if isinstance(content, bytes):
+            return server_utils.dataframe_from_parquet_bytes(content)
+        return server_utils.dataframe_from_dict(content["data"])

@@ -1,0 +1,120 @@
+"""
+Prediction forwarders: callables the client invokes per prediction batch.
+
+Reference parity: gordo-client's ``ForwardPredictionsIntoInflux`` (used by
+the workflow's client pods to push results into the per-project InfluxDB,
+argo-workflow.yml.template:1336-1345). Influx is gated on the driver being
+installed; ``ForwardPredictionsToDisk`` is the built-in always-available
+sink (parquet files per machine — the same columnar format the serving
+stack already speaks).
+"""
+
+import abc
+import logging
+import os
+from typing import Any, Optional
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+
+class PredictionForwarder(abc.ABC):
+    @abc.abstractmethod
+    def forward(
+        self, predictions: pd.DataFrame, machine: str, metadata: dict
+    ) -> None:
+        """Deliver one batch of predictions for one machine."""
+
+    def __call__(
+        self,
+        predictions: pd.DataFrame,
+        machine: Any = None,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        self.forward(predictions, str(machine), metadata or {})
+
+
+class ForwardPredictionsToDisk(PredictionForwarder):
+    """Append prediction batches as parquet files under dir/machine/."""
+
+    def __init__(self, destination_dir: str):
+        self.destination_dir = destination_dir
+        self._counters: dict = {}
+
+    def forward(
+        self, predictions: pd.DataFrame, machine: str, metadata: dict
+    ) -> None:
+        machine_dir = os.path.join(self.destination_dir, machine)
+        os.makedirs(machine_dir, exist_ok=True)
+        n = self._counters.get(machine, 0)
+        self._counters[machine] = n + 1
+        # flatten the MultiIndex for parquet column names
+        out = predictions.copy()
+        if isinstance(out.columns, pd.MultiIndex):
+            out.columns = [
+                "|".join(str(part) for part in col if str(part))
+                for col in out.columns
+            ]
+        path = os.path.join(machine_dir, f"batch-{n:06d}.parquet")
+        out.to_parquet(path)
+        logger.info("Forwarded %d rows for %s -> %s", len(out), machine, path)
+
+
+class ForwardPredictionsIntoInflux(PredictionForwarder):
+    """
+    Write total anomaly scores and per-tag errors to InfluxDB.
+
+    Requires the ``influxdb`` package (not bundled); construction succeeds
+    (so configs parse) but forwarding raises if the driver is missing.
+    """
+
+    def __init__(
+        self,
+        destination_influx_uri: str = "",
+        destination_influx_api_key: str = "",
+        destination_influx_recreate: bool = False,
+    ):
+        self.uri = destination_influx_uri
+        self.api_key = destination_influx_api_key
+        self.recreate = destination_influx_recreate
+        self._client = None
+
+    def _influx_client(self):
+        if self._client is None:
+            try:
+                from influxdb import DataFrameClient
+            except ImportError as exc:
+                raise RuntimeError(
+                    "the 'influxdb' package is not installed; use "
+                    "ForwardPredictionsToDisk or install the driver"
+                ) from exc
+            # uri format: <host>:<port>/<db> (reference client convention)
+            host_port, _, database = self.uri.partition("/")
+            host, _, port = host_port.partition(":")
+            database = database or "gordo"
+            self._client = DataFrameClient(
+                host=host or "localhost",
+                port=int(port or 8086),
+                database=database,
+            )
+            if self.recreate:
+                self._client.drop_database(database)
+                self._client.create_database(database)
+        return self._client
+
+    def forward(
+        self, predictions: pd.DataFrame, machine: str, metadata: dict
+    ) -> None:
+        client = self._influx_client()
+        if isinstance(predictions.columns, pd.MultiIndex):
+            top_levels = predictions.columns.get_level_values(0).unique()
+            for level in top_levels:
+                block = predictions[level]
+                client.write_points(
+                    block, measurement=str(level), tags={"machine": machine}
+                )
+        else:
+            client.write_points(
+                predictions, measurement="prediction", tags={"machine": machine}
+            )

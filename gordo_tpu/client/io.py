@@ -1,0 +1,55 @@
+"""
+HTTP plumbing for the client: error taxonomy and response handling.
+
+Reference parity: gordo-client's ``io`` module surface used by the reference
+tests (tests/gordo/client/test_client.py:18-24 imports _handle_response,
+HttpUnprocessableEntity, BadGordoRequest, NotFound, ResourceGone).
+"""
+
+from typing import Any
+
+
+class HttpUnprocessableEntity(Exception):
+    """Server returned 422 — e.g. anomaly endpoint on a plain model."""
+
+
+class BadGordoRequest(Exception):
+    """A 4xx class error that is our fault."""
+
+
+class NotFound(Exception):
+    """Resource not found (404)."""
+
+
+class ResourceGone(Exception):
+    """Resource moved or removed (410) — e.g. an expired revision."""
+
+
+def _handle_response(resp: Any, resource_name: str = "") -> Any:
+    """
+    Map a response onto its decoded payload or a typed exception.
+
+    Accepts any requests-like response object (``status_code``, ``json()``,
+    ``content``, ``headers``).
+    """
+    if 200 <= resp.status_code <= 299:
+        content_type = resp.headers.get("Content-Type", "")
+        if "json" in content_type:
+            return resp.json()
+        return resp.content
+    msg = f"Failed to get {resource_name or 'resource'}: {resp.status_code}"
+    try:
+        detail = resp.json()
+    except Exception:
+        detail = None
+    if detail:
+        msg = f"{msg} — {detail}"
+    if resp.status_code == 422:
+        raise HttpUnprocessableEntity(msg)
+    if resp.status_code == 404:
+        raise NotFound(msg)
+    if resp.status_code == 410:
+        raise ResourceGone(msg)
+    if 400 <= resp.status_code <= 499:
+        raise BadGordoRequest(msg)
+    raise IOError(msg)

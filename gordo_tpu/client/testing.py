@@ -1,0 +1,76 @@
+"""
+In-process session adapter: drive a WSGI app with the requests-style API the
+Client expects.
+
+Reference parity: the reference simulates its remote server by replaying
+HTTP into a Flask test client behind the `responses` library
+(tests/conftest.py:356-440). Here the same idea is a first-class adapter —
+``Client(session=WSGISession(app))`` talks to any gordo-tpu server app
+without sockets, which is also useful for notebook-local serving.
+"""
+
+import threading
+from typing import Any, Optional
+from urllib.parse import urlencode, urlsplit
+
+
+class _ResponseAdapter:
+    """requests-like view over a werkzeug test Response."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self.status_code = resp.status_code
+        self.headers = dict(resp.headers)
+        self.content = resp.get_data()
+
+    def json(self):
+        import json
+
+        return json.loads(self.content)
+
+
+class WSGISession:
+    """Adapter exposing .get/.post against a WSGI app's test client."""
+
+    def __init__(self, app: Any):
+        client = getattr(app, "test_client", None)
+        self._client = client() if callable(client) else app
+        # the shared test client is not thread-safe; the Client may fan out
+        # requests over a thread pool (same mutex idea as reference
+        # tests/conftest.py:32,408)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _path(url: str, params: Optional[dict]) -> str:
+        parts = urlsplit(url)
+        path = parts.path
+        query = parts.query
+        if params:
+            extra = urlencode(params)
+            query = f"{query}&{extra}" if query else extra
+        return f"{path}?{query}" if query else path
+
+    def get(self, url: str, params: Optional[dict] = None, **kwargs):
+        with self._lock:
+            return _ResponseAdapter(self._client.get(self._path(url, params)))
+
+    def post(
+        self,
+        url: str,
+        params: Optional[dict] = None,
+        json: Optional[dict] = None,
+        files: Optional[dict] = None,
+        **kwargs,
+    ):
+        path = self._path(url, params)
+        with self._lock:
+            if files is not None:
+                data = {
+                    name: (stream, name) for name, stream in files.items()
+                }
+                resp = self._client.post(
+                    path, data=data, content_type="multipart/form-data"
+                )
+            else:
+                resp = self._client.post(path, json=json)
+        return _ResponseAdapter(resp)

@@ -1,0 +1,13 @@
+"""Client utility types (reference: gordo-client ``utils.PredictionResult``)."""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import pandas as pd
+
+
+@dataclass(frozen=True)
+class PredictionResult:
+    name: str
+    predictions: Optional[pd.DataFrame]
+    error_messages: List[str] = field(default_factory=list)

@@ -29,6 +29,8 @@ GORDO_COMPAT_ALIASES = {
     "gordo.machine.model.anomaly.diff.DiffBasedKFCVAnomalyDetector": "gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector",
     "gordo.machine.model.transformers.imputer.InfImputer": "gordo_tpu.models.transformers.imputer.InfImputer",
     "gordo.machine.model.transformer_funcs.general.multiply_by": "gordo_tpu.models.transformer_funcs.general.multiply_by",
+    "gordo.reporters.postgres.PostgresReporter": "gordo_tpu.reporters.postgres.PostgresReporter",
+    "gordo.reporters.mlflow.MlFlowReporter": "gordo_tpu.reporters.mlflow.MlFlowReporter",
     # keras callback paths from reference configs map onto our host-loop callbacks
     "tensorflow.keras.callbacks.EarlyStopping": "gordo_tpu.models.callbacks.EarlyStopping",
     "keras.callbacks.EarlyStopping": "gordo_tpu.models.callbacks.EarlyStopping",

@@ -1,0 +1,166 @@
+"""
+Client tests — run against the real server app in-process via WSGISession
+(the reference runs gordo-client against a replayed Flask test client,
+tests/gordo/client/test_client.py + tests/conftest.py:356-440).
+"""
+
+import pandas as pd
+import pytest
+
+from gordo_tpu.client import Client, PredictionResult
+from gordo_tpu.client.forwarders import ForwardPredictionsToDisk
+from gordo_tpu.client.io import (
+    BadGordoRequest,
+    HttpUnprocessableEntity,
+    NotFound,
+    ResourceGone,
+    _handle_response,
+)
+from gordo_tpu.client.testing import WSGISession
+from gordo_tpu.server import build_app
+from gordo_tpu.server import utils as server_utils
+
+
+@pytest.fixture(scope="module")
+def app(model_collection_directory, trained_model_directories):
+    server_utils.clear_model_caches()
+    return build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+
+
+@pytest.fixture
+def client(app, gordo_project):
+    return Client(
+        project=gordo_project,
+        session=WSGISession(app),
+        batch_size=500,
+        parallelism=2,
+    )
+
+
+def test_client_get_machines(client, gordo_name, second_gordo_name):
+    names = client.get_machine_names()
+    assert set(names) == {gordo_name, second_gordo_name}
+
+
+def test_client_get_revisions(client, gordo_revision):
+    revisions = client.get_revisions()
+    assert gordo_revision in revisions["available-revisions"]
+    assert revisions["latest"] == gordo_revision
+
+
+def test_client_get_metadata(client, gordo_name):
+    metadata = client.get_metadata()
+    assert gordo_name in metadata
+    assert metadata[gordo_name]["name"] == gordo_name
+    assert "dataset" in metadata[gordo_name]
+    # filtering by target
+    only = client.get_metadata(targets=[gordo_name])
+    assert list(only) == [gordo_name]
+
+
+def test_client_metadata_unknown_target(client):
+    with pytest.raises(NotFound):
+        client.get_metadata(targets=["no-such-machine"])
+
+
+def test_client_download_model(client, gordo_name, sensors):
+    models = client.download_model(targets=[gordo_name])
+    model = models[gordo_name]
+    idx = pd.date_range("2020-01-01", periods=16, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        [[0.5] * 4] * 16, columns=[t.name for t in sensors], index=idx
+    )
+    out = model.predict(X)
+    assert out.shape == (16, 4)
+
+
+@pytest.mark.parametrize("use_parquet", [True, False])
+def test_client_predict(app, gordo_project, gordo_name, use_parquet, tmp_path):
+    forwarder = ForwardPredictionsToDisk(str(tmp_path / "fwd"))
+    client = Client(
+        project=gordo_project,
+        session=WSGISession(app),
+        use_parquet=use_parquet,
+        prediction_forwarder=forwarder,
+    )
+    results = client.predict(
+        "2020-03-01T00:00:00+00:00",
+        "2020-03-02T00:00:00+00:00",
+        targets=[gordo_name],
+    )
+    assert len(results) == 1
+    result = results[0]
+    assert isinstance(result, PredictionResult)
+    assert result.error_messages == []
+    assert result.predictions is not None
+    assert len(result.predictions) > 0
+    assert "total-anomaly-scaled" in set(
+        result.predictions.columns.get_level_values(0)
+    )
+    # forwarder received every batch
+    forwarded = list((tmp_path / "fwd" / gordo_name).glob("*.parquet"))
+    assert forwarded
+
+
+def test_client_predict_unknown_target(client):
+    with pytest.raises(NotFound):
+        client.predict(
+            "2020-03-01T00:00:00+00:00",
+            "2020-03-02T00:00:00+00:00",
+            targets=["nope"],
+        )
+
+
+def test_handle_response_errors():
+    class FakeResp:
+        headers = {"Content-Type": "application/json"}
+
+        def __init__(self, status_code, payload=None):
+            self.status_code = status_code
+            self._payload = payload or {}
+            self.content = b"{}"
+
+        def json(self):
+            return self._payload
+
+    assert _handle_response(FakeResp(200, {"ok": 1})) == {"ok": 1}
+    with pytest.raises(HttpUnprocessableEntity):
+        _handle_response(FakeResp(422))
+    with pytest.raises(NotFound):
+        _handle_response(FakeResp(404))
+    with pytest.raises(ResourceGone):
+        _handle_response(FakeResp(410))
+    with pytest.raises(BadGordoRequest):
+        _handle_response(FakeResp(400))
+    with pytest.raises(IOError):
+        _handle_response(FakeResp(500))
+
+
+def test_client_cli_metadata(app, gordo_project, gordo_name, monkeypatch, tmp_path):
+    from click.testing import CliRunner
+
+    import gordo_tpu.client.cli as client_cli
+
+    def patched_client(**kwargs):
+        kwargs.pop("session", None)
+        return Client(session=WSGISession(app), **kwargs)
+
+    monkeypatch.setattr(client_cli, "Client", patched_client)
+    out = tmp_path / "meta.json"
+    runner = CliRunner()
+    result = runner.invoke(
+        client_cli.gordo_client,
+        [
+            "--project",
+            gordo_project,
+            "metadata",
+            "--target",
+            gordo_name,
+            "--output-file",
+            str(out),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    import json
+
+    assert gordo_name in json.loads(out.read_text())

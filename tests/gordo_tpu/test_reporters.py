@@ -1,0 +1,174 @@
+"""
+Reporter tests.
+
+Mirrors the reference strategy: postgres exercised against a real DB-API
+connection (sqlite3 stands in for the dockerized postgres 11 the reference
+uses, tests/conftest.py:270-332); mlflow batching logic tested pure
+(reference tests/gordo/reporters/test_mlflow.py).
+"""
+
+import json
+import sqlite3
+
+import pytest
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.reporters.base import BaseReporter
+from gordo_tpu.reporters.mlflow import (
+    MAX_METRICS_PER_BATCH,
+    MAX_PARAMS_PER_BATCH,
+    MlFlowReporter,
+    MlFlowReporterException,
+    batch,
+    extract_metrics_and_params,
+    get_batch_kwargs,
+)
+from gordo_tpu.reporters.postgres import (
+    PostgresReporter,
+    PostgresReporterException,
+)
+
+
+@pytest.fixture
+def machine():
+    return Machine.from_config(
+        {
+            "name": "report-machine",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["tag-1", "tag-2"],
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+            },
+            "model": {
+                "gordo_tpu.models.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass"
+                }
+            },
+        },
+        project_name="test-proj",
+    )
+
+
+@pytest.fixture
+def sqlite_factory(tmp_path):
+    db = str(tmp_path / "reporter.db")
+
+    def connect():
+        return sqlite3.connect(db)
+
+    return connect
+
+
+def test_postgres_reporter_upserts(machine, sqlite_factory):
+    reporter = PostgresReporter(
+        connection_factory=sqlite_factory, paramstyle="?"
+    )
+    reporter.report(machine)
+    reporter.report(machine)  # second report upserts, not duplicates
+
+    conn = sqlite_factory()
+    rows = conn.execute("SELECT name, model FROM machine").fetchall()
+    assert len(rows) == 1
+    assert rows[0][0] == "report-machine"
+    assert "AutoEncoder" in rows[0][1]
+    conn.close()
+
+
+def test_postgres_reporter_requires_target():
+    with pytest.raises(ValueError):
+        PostgresReporter()
+
+
+def test_postgres_reporter_connect_failure(machine):
+    def broken():
+        raise OSError("no route to host")
+
+    reporter = PostgresReporter(connection_factory=broken)
+    with pytest.raises(PostgresReporterException):
+        reporter.report(machine)
+
+
+def test_postgres_reporter_from_runtime_config(machine, sqlite_factory):
+    """Reporters declared in runtime config resolve through the serializer."""
+    reporter = BaseReporter.from_dict(
+        {
+            "gordo_tpu.reporters.postgres.PostgresReporter": {
+                "host": "example.com"
+            }
+        }
+    )
+    assert isinstance(reporter, PostgresReporter)
+    # reference-path alias too
+    reporter = BaseReporter.from_dict(
+        {"gordo.reporters.postgres.PostgresReporter": {"host": "example.com"}}
+    )
+    assert isinstance(reporter, PostgresReporter)
+
+
+def test_machine_report_dispatch(machine, sqlite_factory, monkeypatch):
+    """Machine.report() runs every reporter in runtime.reporters."""
+    import gordo_tpu.reporters.postgres as pg
+
+    seen = []
+    monkeypatch.setattr(
+        PostgresReporter, "report", lambda self, m: seen.append(m.name)
+    )
+    machine.runtime["reporters"] = [
+        {
+            "gordo_tpu.reporters.postgres.PostgresReporter": {
+                "host": "example.com"
+            }
+        }
+    ]
+    machine.report()
+    assert seen == ["report-machine"]
+
+
+def _machine_dict_with_scores(n_metrics=2, n_epochs=3):
+    scores = {
+        f"metric-{i}": {"mean": 0.5, "std": 0.1, "max": 0.9, "min": 0.2}
+        for i in range(n_metrics)
+    }
+    return {
+        "metadata": {
+            "build_metadata": {
+                "model": {
+                    "cross_validation": {
+                        "scores": scores,
+                        "cv_duration_sec": 12.5,
+                    },
+                    "history": {"loss": [float(i) for i in range(n_epochs)]},
+                    "model_training_duration_sec": 3.2,
+                }
+            }
+        }
+    }
+
+
+def test_extract_metrics_and_params():
+    metrics, params = extract_metrics_and_params(_machine_dict_with_scores())
+    metric_keys = {k for k, _ in metrics}
+    assert "metric-0-mean" in metric_keys
+    assert "history-loss-epoch-2" in metric_keys
+    param_keys = {k for k, _ in params}
+    assert {"cv_duration_sec", "model_training_duration_sec"} <= param_keys
+
+
+def test_batching_respects_limits():
+    assert batch(list(range(5)), 2) == [[0, 1], [2, 3], [4]]
+    with pytest.raises(ValueError):
+        batch([1], 0)
+    # 80 metrics/metric-stats * 4 + 60 epochs > 200 -> multiple batches
+    machine_dict = _machine_dict_with_scores(n_metrics=80, n_epochs=60)
+    calls = get_batch_kwargs(machine_dict)
+    assert len(calls) >= 2
+    for call in calls:
+        assert len(call["metrics"]) <= MAX_METRICS_PER_BATCH
+        assert len(call["params"]) <= MAX_PARAMS_PER_BATCH
+
+
+def test_mlflow_reporter_missing_dependency(machine):
+    reporter = MlFlowReporter()
+    with pytest.raises(MlFlowReporterException):
+        reporter.report(machine)

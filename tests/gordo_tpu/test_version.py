@@ -1,0 +1,43 @@
+"""Version grammar tests (reference: tests/gordo/util/test_version.py)."""
+
+import pytest
+
+from gordo_tpu.util.version import (
+    GordoPR,
+    GordoRelease,
+    GordoSHA,
+    GordoSpecial,
+    parse_version,
+)
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        ("latest", GordoSpecial("latest")),
+        ("stable", GordoSpecial("stable")),
+        ("pr-42", GordoPR(42)),
+        ("1", GordoRelease(1)),
+        ("1.2", GordoRelease(1, 2)),
+        ("1.2.3", GordoRelease(1, 2, 3)),
+        ("1.2.3-rc1", GordoRelease(1, 2, 3, "-rc1")),
+        ("1.2.3.dev1", GordoRelease(1, 2, 3, ".dev1")),
+        ("deadbeefcafe", GordoSHA("deadbeefcafe")),
+    ],
+)
+def test_parse_version(value, expected):
+    parsed = parse_version(value)
+    assert parsed == expected
+    assert parsed.get_version() == value
+
+
+def test_release_shape_predicates():
+    assert GordoRelease(1).only_major()
+    assert GordoRelease(1, 2).only_major_minor()
+    assert not GordoRelease(1, 2, 3).only_major()
+
+
+@pytest.mark.parametrize("bad", ["", "???", "v", "pr-", "xyz!"])
+def test_parse_version_invalid(bad):
+    with pytest.raises(ValueError):
+        parse_version(bad)
