@@ -9,7 +9,18 @@ pytrees of ``jnp`` arrays, and training is a single XLA program
 ``vmap``-able for the batched multi-machine trainer (gordo_tpu.parallel).
 """
 
-from . import models  # noqa: F401 — registers factories
 from .base import GordoBase
 
 __all__ = ["GordoBase", "models"]
+
+
+def __getattr__(name):
+    # Lazy so that `gordo_tpu.ops.*` (whose modules import
+    # gordo_tpu.models.spec, and hence this package) can be imported first
+    # without tripping the ops ↔ models cycle; importing `.models` eagerly
+    # here would pull gordo_tpu.ops.train back in mid-initialization.
+    if name == "models":
+        import importlib
+
+        return importlib.import_module(".models", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -4,6 +4,8 @@ from .feedforward_autoencoder import (
     feedforward_hourglass,
 )
 from .lstm_autoencoder import lstm_model, lstm_symmetric, lstm_hourglass
+from .transformer import transformer_model
+from .tcn import tcn_model
 
 __all__ = [
     "feedforward_model",
@@ -12,4 +14,6 @@ __all__ = [
     "lstm_model",
     "lstm_symmetric",
     "lstm_hourglass",
+    "transformer_model",
+    "tcn_model",
 ]

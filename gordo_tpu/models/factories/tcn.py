@@ -1,0 +1,71 @@
+"""
+Temporal Convolutional Network factories (NEW capability — no reference
+analog; the BASELINE stretch config calls for a Transformer/TCN family).
+
+Stacked causal dilated-conv residual blocks with doubling dilations — the
+receptive field grows exponentially with depth, so a lookback window of
+hundreds of rows is covered by a handful of blocks. Convs are NWC/WIO
+``lax.conv_general_dilated`` calls that XLA tiles onto the MXU; everything is
+shape-static and vmap-safe for the batched multi-machine trainer.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.spec import DenseLayer, ModelSpec, PoolLayer, TCNBlock
+from .feedforward_autoencoder import _optimizer_spec
+
+
+@register_model_builder(type="TCNAutoEncoder")
+@register_model_builder(type="TCNForecast")
+def tcn_model(
+    n_features: int,
+    n_features_out: int = None,
+    lookback_window: int = 144,
+    filters: int = 64,
+    kernel_size: int = 3,
+    num_blocks: int = 4,
+    dilations: Optional[Tuple[int, ...]] = None,
+    func: str = "relu",
+    out_func: str = "linear",
+    pool: str = "last",
+    optimizer: str = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compile_kwargs: Optional[Dict[str, Any]] = None,
+    lookahead: int = 0,
+    **kwargs,
+) -> ModelSpec:
+    """Windowed (many-to-one) TCN. Default dilations: 1, 2, 4, ... per block."""
+    n_features_out = n_features_out or n_features
+    if lookback_window < 2:
+        raise ValueError(
+            f"tcn_model requires lookback_window >= 2, got {lookback_window}"
+        )
+    if dilations is None:
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        dilations = tuple(2**i for i in range(int(num_blocks)))
+    elif not dilations:
+        raise ValueError("dilations must be non-empty")
+    layers = [
+        TCNBlock(
+            filters=int(filters),
+            kernel_size=int(kernel_size),
+            dilation=int(d),
+            activation=func,
+        )
+        for d in dilations
+    ]
+    layers.append(PoolLayer(mode=pool))
+    layers.append(DenseLayer(units=int(n_features_out), activation=out_func))
+
+    loss = (compile_kwargs or {}).get("loss", "mse")
+    return ModelSpec(
+        layers=tuple(layers),
+        n_features=int(n_features),
+        n_features_out=int(n_features_out),
+        lookback_window=int(lookback_window),
+        lookahead=int(lookahead),
+        optimizer=_optimizer_spec(optimizer, optimizer_kwargs),
+        loss=loss,
+    )

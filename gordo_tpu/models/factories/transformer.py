@@ -1,0 +1,83 @@
+"""
+Transformer anomaly-model factories (NEW capability — no reference analog).
+
+The reference's model zoo stops at LSTMs (gordo/machine/model/factories/);
+its survey explicitly lists attention/long-context as absent. These factories
+follow the same registry contract (kind names registered per estimator class,
+``n_features`` first arg, ModelSpec out) so Transformer machines drop into the
+same configs, builder, batched trainer, and server as every other kind.
+
+Architecture: Dense projection to ``d_model`` → sinusoidal positional
+encoding → N pre-LN encoder blocks (MHA rides the MXU via
+gordo_tpu.ops.attention; flash/pallas or ring attention for long windows) →
+time-pool → Dense head.
+"""
+
+from typing import Any, Dict, Optional
+
+from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.spec import (
+    DenseLayer,
+    ModelSpec,
+    PoolLayer,
+    PositionalEncoding,
+    TransformerBlock,
+)
+from .feedforward_autoencoder import _optimizer_spec
+
+
+@register_model_builder(type="TransformerAutoEncoder")
+@register_model_builder(type="TransformerForecast")
+def transformer_model(
+    n_features: int,
+    n_features_out: int = None,
+    lookback_window: int = 144,
+    d_model: int = 64,
+    num_heads: int = 4,
+    ff_dim: int = 128,
+    num_blocks: int = 2,
+    func: str = "relu",
+    out_func: str = "linear",
+    causal: bool = True,
+    pool: str = "last",
+    optimizer: str = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compile_kwargs: Optional[Dict[str, Any]] = None,
+    lookahead: int = 0,
+    **kwargs,
+) -> ModelSpec:
+    """Windowed (many-to-one) Transformer encoder."""
+    n_features_out = n_features_out or n_features
+    if num_blocks < 1:
+        raise ValueError("num_blocks must be >= 1")
+    if lookback_window < 2:
+        raise ValueError(
+            f"transformer_model requires lookback_window >= 2, got {lookback_window}"
+        )
+    layers = [
+        DenseLayer(units=int(d_model), activation="linear"),
+        PositionalEncoding(),
+    ]
+    for _ in range(int(num_blocks)):
+        layers.append(
+            TransformerBlock(
+                d_model=int(d_model),
+                num_heads=int(num_heads),
+                ff_dim=int(ff_dim),
+                activation=func,
+                causal=bool(causal),
+            )
+        )
+    layers.append(PoolLayer(mode=pool))
+    layers.append(DenseLayer(units=int(n_features_out), activation=out_func))
+
+    loss = (compile_kwargs or {}).get("loss", "mse")
+    return ModelSpec(
+        layers=tuple(layers),
+        n_features=int(n_features),
+        n_features_out=int(n_features_out),
+        lookback_window=int(lookback_window),
+        lookahead=int(lookahead),
+        optimizer=_optimizer_spec(optimizer, optimizer_kwargs),
+        loss=loss,
+    )

@@ -291,6 +291,70 @@ class LSTMForecast(LSTMBaseEstimator):
         return 1
 
 
+class WindowedSequenceEstimator(LSTMBaseEstimator):
+    """
+    Base for sequence models whose layers require a real time axis
+    (Transformer/TCN): unlike LSTMs, a lookback_window of 1 is meaningless,
+    so the default is the canonical 144-row day window (reference KFCV
+    default, gordo/machine/model/anomaly/diff.py:472) and windows < 2 are
+    rejected at construction time.
+    """
+
+    def __init__(self, kind, lookback_window: int = 144, batch_size: int = 32, **kwargs):
+        if lookback_window < 2:
+            raise ValueError(
+                f"{type(self).__name__} requires lookback_window >= 2, "
+                f"got {lookback_window}"
+            )
+        super().__init__(
+            kind, lookback_window=lookback_window, batch_size=batch_size, **kwargs
+        )
+
+
+class TransformerAutoEncoder(WindowedSequenceEstimator):
+    """
+    Windowed Transformer-encoder reconstructor (lookahead=0). NEW capability:
+    the reference zoo has no attention models (SURVEY §5); this class follows
+    the same windowed many-to-one contract as :class:`LSTMAutoEncoder`.
+    """
+
+    factory_type = "TransformerAutoEncoder"
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+
+class TransformerForecast(WindowedSequenceEstimator):
+    """Windowed Transformer one-step forecaster (lookahead=1)."""
+
+    factory_type = "TransformerForecast"
+
+    @property
+    def lookahead(self) -> int:
+        return 1
+
+
+class TCNAutoEncoder(WindowedSequenceEstimator):
+    """Windowed temporal-convolutional reconstructor (lookahead=0)."""
+
+    factory_type = "TCNAutoEncoder"
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+
+class TCNForecast(WindowedSequenceEstimator):
+    """Windowed temporal-convolutional one-step forecaster (lookahead=1)."""
+
+    factory_type = "TCNForecast"
+
+    @property
+    def lookahead(self) -> int:
+        return 1
+
+
 class RawModelRegressor(AutoEncoder):
     """
     Build an arbitrary layer stack from a raw config dict

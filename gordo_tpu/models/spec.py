@@ -30,7 +30,53 @@ class LSTMLayer:
     return_sequences: bool = False
 
 
-LayerSpec = Union[DenseLayer, LSTMLayer]
+@dataclass(frozen=True)
+class PositionalEncoding:
+    """Parameter-free sinusoidal positional encoding added to a (B, T, D)
+    sequence (new capability — the reference has no attention models)."""
+
+    max_wavelength: float = 10000.0
+
+
+@dataclass(frozen=True)
+class TransformerBlock:
+    """
+    Pre-LayerNorm Transformer encoder block: MHA + residual, FFN + residual.
+    Input and output are (B, T, d_model); ``d_model`` must match the incoming
+    feature dim (factories insert a Dense projection first).
+    """
+
+    d_model: int
+    num_heads: int = 4
+    ff_dim: int = 128
+    activation: str = "relu"
+    causal: bool = False
+
+
+@dataclass(frozen=True)
+class TCNBlock:
+    """
+    Temporal-convolutional residual block: two causal dilated 1-D convs with
+    a residual (1×1-projected when channel counts differ). (B, T, C_in) →
+    (B, T, filters).
+    """
+
+    filters: int
+    kernel_size: int = 3
+    dilation: int = 1
+    activation: str = "relu"
+
+
+@dataclass(frozen=True)
+class PoolLayer:
+    """Collapse the time axis: (B, T, D) → (B, D). mode ∈ {last, mean, max}."""
+
+    mode: str = "last"
+
+
+LayerSpec = Union[
+    DenseLayer, LSTMLayer, PositionalEncoding, TransformerBlock, TCNBlock, PoolLayer
+]
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,119 @@
+"""
+Multi-head scaled-dot-product attention with pluggable implementations.
+
+The reference has no attention models at all (SURVEY §5: "long-context /
+sequence parallelism: absent") — this op underpins the *new-capability*
+Transformer model family (BASELINE.json stretch config) and is written
+TPU-first:
+
+- ``impl="xla"``: plain jnp einsum formulation — XLA fuses softmax into the
+  two MXU matmuls; this is the reference implementation and CPU/test path.
+- ``impl="flash"``: Pallas TPU kernel (blockwise online-softmax, O(T) memory;
+  see :mod:`gordo_tpu.ops.pallas_kernels.flash_attention`).
+- ``impl="auto"``: flash on TPU when shapes satisfy the kernel's tiling
+  constraints, else xla.
+
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _default_impl() -> str:
+    return os.environ.get("GORDO_TPU_ATTENTION_IMPL", "auto")
+
+
+def split_heads(x: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """(B, T, D) -> (B, H, T, D//H)"""
+    b, t, d = x.shape
+    if d % num_heads:
+        raise ValueError(f"model dim {d} not divisible by num_heads {num_heads}")
+    return x.reshape(b, t, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, T, Dh) -> (B, T, H*Dh)"""
+    b, h, t, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+
+def dot_product_attention_xla(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False
+) -> jnp.ndarray:
+    """
+    Reference attention. q, k, v: (..., T, Dh) with any leading batch dims.
+
+    Softmax is computed in float32 regardless of input dtype (bfloat16-safe),
+    matching the flash kernel's accumulator precision.
+    """
+    dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t_q, t_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), t_k - t_q)
+        logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+def _flash_ok(q: jnp.ndarray) -> bool:
+    """
+    Whether the Pallas flash kernel supports these shapes on this backend.
+    The kernel needs T divisible by its 128-row blocks and a lane-friendly
+    head dim; below ~256 rows the O(T²) XLA path is already VMEM-resident
+    and the kernel buys nothing.
+    """
+    if jax.default_backend() != "tpu":
+        return False
+    t, dh = q.shape[-2], q.shape[-1]
+    return t >= 256 and t % 128 == 0 and dh % 8 == 0
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    impl: str = None,
+) -> jnp.ndarray:
+    """
+    Dispatching attention over (..., T, Dh) tensors.
+
+    Deliberately not jitted at this level: the impl choice (including the
+    ``GORDO_TPU_ATTENTION_IMPL`` env override) must be re-read per call, not
+    baked into a jit cache; callers jit the surrounding model anyway.
+    """
+    impl = impl or _default_impl()
+    if impl == "auto":
+        impl = "flash" if _flash_ok(q) else "xla"
+    if impl == "flash":
+        from gordo_tpu.ops.pallas_kernels.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "xla":
+        return dot_product_attention_xla(q, k, v, causal=causal)
+    raise ValueError(f"Unknown attention impl {impl!r}")
+
+
+def multihead_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    num_heads: int,
+    causal: bool = False,
+    impl: str = None,
+) -> jnp.ndarray:
+    """
+    Multi-head attention over (B, T, D) tensors (projections applied by the
+    caller). Returns (B, T, D).
+    """
+    qh = split_heads(q, num_heads)
+    kh = split_heads(k, num_heads)
+    vh = split_heads(v, num_heads)
+    out = dot_product_attention(qh, kh, vh, causal=causal, impl=impl)
+    return merge_heads(out)

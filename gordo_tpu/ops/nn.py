@@ -15,7 +15,16 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 
-from gordo_tpu.models.spec import DenseLayer, LSTMLayer, ModelSpec
+from gordo_tpu.models.spec import (
+    DenseLayer,
+    LSTMLayer,
+    ModelSpec,
+    PoolLayer,
+    PositionalEncoding,
+    TCNBlock,
+    TransformerBlock,
+)
+from gordo_tpu.ops.attention import multihead_attention
 
 Params = List[Dict[str, Any]]
 
@@ -74,6 +83,62 @@ def init_lstm_layer(rng, in_dim: int, units: int) -> Dict[str, jnp.ndarray]:
     }
 
 
+def init_transformer_block(rng, in_dim: int, layer: TransformerBlock):
+    if in_dim != layer.d_model:
+        raise ValueError(
+            f"TransformerBlock d_model={layer.d_model} but incoming dim is "
+            f"{in_dim}; insert a Dense projection first"
+        )
+    d, ff = layer.d_model, layer.ff_dim
+    ks = jax.random.split(rng, 6)
+    return {
+        "ln1_scale": jnp.ones((d,), jnp.float32),
+        "ln1_bias": jnp.zeros((d,), jnp.float32),
+        "wq": _glorot_uniform(ks[0], (d, d)),
+        "wk": _glorot_uniform(ks[1], (d, d)),
+        "wv": _glorot_uniform(ks[2], (d, d)),
+        "wo": _glorot_uniform(ks[3], (d, d)),
+        "bq": jnp.zeros((d,), jnp.float32),
+        "bk": jnp.zeros((d,), jnp.float32),
+        "bv": jnp.zeros((d,), jnp.float32),
+        "bo": jnp.zeros((d,), jnp.float32),
+        "ln2_scale": jnp.ones((d,), jnp.float32),
+        "ln2_bias": jnp.zeros((d,), jnp.float32),
+        "w_ff1": _glorot_uniform(ks[4], (d, ff)),
+        "b_ff1": jnp.zeros((ff,), jnp.float32),
+        "w_ff2": _glorot_uniform(ks[5], (ff, d)),
+        "b_ff2": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def init_tcn_block(rng, in_dim: int, layer: TCNBlock):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    filters, ksize = layer.filters, layer.kernel_size
+    params = {
+        # conv kernels in WIO layout: (width, in_channels, out_channels)
+        "conv1_kernel": _glorot_uniform(k1, (ksize, in_dim, filters)),
+        "conv1_bias": jnp.zeros((filters,), jnp.float32),
+        "conv2_kernel": _glorot_uniform(k2, (ksize, filters, filters)),
+        "conv2_bias": jnp.zeros((filters,), jnp.float32),
+    }
+    if in_dim != filters:
+        params["res_kernel"] = _glorot_uniform(k3, (1, in_dim, filters))
+    return params
+
+
+def layer_out_dim(layer, in_dim: int) -> int:
+    """Feature dimension a layer produces given its input dimension."""
+    if isinstance(layer, (DenseLayer, LSTMLayer)):
+        return layer.units
+    if isinstance(layer, TransformerBlock):
+        return layer.d_model
+    if isinstance(layer, TCNBlock):
+        return layer.filters
+    if isinstance(layer, (PositionalEncoding, PoolLayer)):
+        return in_dim
+    raise TypeError(f"Unknown layer spec: {layer!r}")
+
+
 def init_model_params(rng: jax.Array, spec: ModelSpec) -> Params:
     """Initialize the full parameter pytree for a ModelSpec."""
     params: Params = []
@@ -84,9 +149,15 @@ def init_model_params(rng: jax.Array, spec: ModelSpec) -> Params:
             params.append(init_dense_layer(layer_rng, in_dim, layer.units))
         elif isinstance(layer, LSTMLayer):
             params.append(init_lstm_layer(layer_rng, in_dim, layer.units))
+        elif isinstance(layer, TransformerBlock):
+            params.append(init_transformer_block(layer_rng, in_dim, layer))
+        elif isinstance(layer, TCNBlock):
+            params.append(init_tcn_block(layer_rng, in_dim, layer))
+        elif isinstance(layer, (PositionalEncoding, PoolLayer)):
+            params.append({})
         else:
             raise TypeError(f"Unknown layer spec: {layer!r}")
-        in_dim = layer.units
+        in_dim = layer_out_dim(layer, in_dim)
     return params
 
 
@@ -126,6 +197,72 @@ def _apply_lstm(layer: LSTMLayer, p, x):
     return h
 
 
+def _layer_norm(x, scale, bias, eps=1e-6):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _apply_positional_encoding(layer: PositionalEncoding, x):
+    """x: (batch, time, d). Sinusoidal PE (Vaswani et al.), added to x."""
+    _, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = (d + 1) // 2
+    freqs = jnp.exp(
+        -jnp.log(layer.max_wavelength) * jnp.arange(half, dtype=jnp.float32)
+        / jnp.maximum(half - 1, 1)
+    )[None, :]
+    angles = pos * freqs
+    pe = jnp.zeros((t, d), x.dtype)
+    pe = pe.at[:, 0::2].set(jnp.sin(angles)[:, : (d + 1) // 2])
+    pe = pe.at[:, 1::2].set(jnp.cos(angles)[:, : d // 2])
+    return x + pe[None, :, :]
+
+
+def _apply_transformer_block(layer: TransformerBlock, p, x):
+    """Pre-LN encoder block. x: (batch, time, d_model)."""
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    q = h @ p["wq"] + p["bq"]
+    k = h @ p["wk"] + p["bk"]
+    v = h @ p["wv"] + p["bv"]
+    attn = multihead_attention(q, k, v, layer.num_heads, causal=layer.causal)
+    x = x + attn @ p["wo"] + p["bo"]
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    ff = _activation(layer.activation)(h @ p["w_ff1"] + p["b_ff1"])
+    return x + ff @ p["w_ff2"] + p["b_ff2"]
+
+
+def _causal_conv1d(x, kernel, dilation: int):
+    """Causal dilated conv. x: (batch, time, c_in), kernel: (width, c_in, c_out)."""
+    left_pad = (kernel.shape[0] - 1) * dilation
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1,),
+        padding=[(left_pad, 0)],
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+
+
+def _apply_tcn_block(layer: TCNBlock, p, x):
+    act = _activation(layer.activation)
+    h = act(_causal_conv1d(x, p["conv1_kernel"], layer.dilation) + p["conv1_bias"])
+    h = act(_causal_conv1d(h, p["conv2_kernel"], layer.dilation) + p["conv2_bias"])
+    res = x if "res_kernel" not in p else _causal_conv1d(x, p["res_kernel"], 1)
+    return act(h + res)
+
+
+def _apply_pool(layer: PoolLayer, x):
+    if layer.mode == "last":
+        return x[:, -1, :]
+    if layer.mode == "mean":
+        return jnp.mean(x, axis=1)
+    if layer.mode == "max":
+        return jnp.max(x, axis=1)
+    raise ValueError(f"Unknown pool mode {layer.mode!r}")
+
+
 def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
     """
     Forward pass.
@@ -145,6 +282,14 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
                 penalty = penalty + layer.l1_activity * jnp.sum(jnp.abs(out)) / batch
         elif isinstance(layer, LSTMLayer):
             out = _apply_lstm(layer, p, out)
+        elif isinstance(layer, PositionalEncoding):
+            out = _apply_positional_encoding(layer, out)
+        elif isinstance(layer, TransformerBlock):
+            out = _apply_transformer_block(layer, p, out)
+        elif isinstance(layer, TCNBlock):
+            out = _apply_tcn_block(layer, p, out)
+        elif isinstance(layer, PoolLayer):
+            out = _apply_pool(layer, out)
         else:
             raise TypeError(f"Unknown layer spec: {layer!r}")
     return out, penalty

@@ -1,0 +1,129 @@
+"""
+Flash attention for TPU in Pallas: blockwise online-softmax attention that
+never materializes the (T, T) score matrix in HBM.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+- Grid: (batch*heads, T // BLOCK_Q). Each program owns one query block in
+  VMEM; K/V for its (batch, head) slice are staged into VMEM whole, and the
+  kernel loops over key blocks with the standard running (max, denom, acc)
+  online-softmax update. Score blocks are (BLOCK_Q, BLOCK_K) fp32 — VPU-sized
+  — and the two matmuls per block ride the MXU.
+- Accumulation in float32 regardless of input dtype (bfloat16-safe).
+- Backward: ``jax.custom_vjp`` recomputing the XLA reference attention —
+  exact gradients (the kernel is numerically equivalent), O(T²) memory only
+  inside the backward pass. A fused backward kernel is a future optimization.
+
+The kernel runs under ``interpret=True`` on CPU so tests exercise the real
+kernel logic without TPU hardware.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                  block_k: int):
+    """One query block vs all key blocks, online softmax."""
+    q = q_ref[0].astype(jnp.float32)  # (BLOCK_Q, Dh)
+    block_q, dh = q.shape
+    t_k = k_ref.shape[1]
+    n_kb = t_k // block_k
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k_blk.T) * scale  # (BLOCK_Q, BLOCK_K)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + p @ v_blk
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, dh), jnp.float32)
+    _, l_fin, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, interpret: bool):
+    """q, k, v: (BH, T, Dh) — flattened leading batch*heads axis."""
+    bh, t, dh = q.shape
+    block_q = min(BLOCK_Q, t)
+    block_k = min(BLOCK_K, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"sequence length {t} must be divisible by {block_q}")
+    scale = 1.0 / (dh**0.5)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, causal, interpret):
+    return _flash_forward(q, k, v, causal, interpret)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, residuals, g):
+    from gordo_tpu.ops.attention import dot_product_attention_xla
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: dot_product_attention_xla(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, interpret: bool = None):
+    """
+    Blockwise flash attention. q, k, v: (..., T, Dh); any leading batch dims.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the kernel is
+    testable on CPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = q.shape[:-2]
+    t, dh = q.shape[-2:]
+    qf = q.reshape((-1, t, dh))
+    kf = k.reshape((-1, k.shape[-2], dh))
+    vf = v.reshape((-1, v.shape[-2], dh))
+    out = _flash_attention(qf, kf, vf, causal, interpret)
+    return out.reshape(lead + (t, dh))

@@ -1,0 +1,239 @@
+"""
+Transformer/TCN model families + attention ops (new capability — the
+reference zoo stops at LSTMs, SURVEY.md §5 "long-context: absent").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.models import models
+from gordo_tpu.models.factories import tcn_model, transformer_model
+from gordo_tpu.models.spec import (
+    ModelSpec,
+    PoolLayer,
+    TCNBlock,
+    TransformerBlock,
+    DenseLayer,
+    PositionalEncoding,
+)
+from gordo_tpu.ops import nn
+from gordo_tpu.ops.attention import (
+    dot_product_attention_xla,
+    multihead_attention,
+)
+from gordo_tpu.ops.pallas_kernels import flash_attention
+from gordo_tpu.serializer import from_definition, into_definition
+
+
+# ---------------------------------------------------------------- attention
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(2, 256, 8).astype(np.float32)) for _ in range(3)
+    )
+    ref = dot_product_attention_xla(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_attention_grad_matches_reference():
+    rng = np.random.RandomState(1)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, 128, 8).astype(np.float32)) for _ in range(3)
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention_xla(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_multihead_attention_shapes_and_heads():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(3, 16, 32).astype(np.float32))
+    out = multihead_attention(x, x, x, num_heads=4)
+    assert out.shape == (3, 16, 32)
+    with pytest.raises(ValueError):
+        multihead_attention(x, x, x, num_heads=5)
+
+
+# ------------------------------------------------------------------ factories
+def test_transformer_factory_spec():
+    spec = transformer_model(
+        n_features=6, lookback_window=32, d_model=16, num_heads=2, num_blocks=3
+    )
+    assert isinstance(spec, ModelSpec)
+    assert spec.lookback_window == 32
+    blocks = [l for l in spec.layers if isinstance(l, TransformerBlock)]
+    assert len(blocks) == 3
+    assert all(b.d_model == 16 and b.num_heads == 2 for b in blocks)
+    assert isinstance(spec.layers[0], DenseLayer) and spec.layers[0].units == 16
+    assert isinstance(spec.layers[1], PositionalEncoding)
+    assert isinstance(spec.layers[-2], PoolLayer)
+    assert spec.layers[-1].units == 6
+    # frozen + hashable → usable as a jit static arg / bucket key
+    assert hash(spec) == hash(
+        transformer_model(
+            n_features=6, lookback_window=32, d_model=16, num_heads=2, num_blocks=3
+        )
+    )
+
+
+def test_tcn_factory_spec_dilations():
+    spec = tcn_model(n_features=4, lookback_window=16, filters=8, num_blocks=3)
+    blocks = [l for l in spec.layers if isinstance(l, TCNBlock)]
+    assert [b.dilation for b in blocks] == [1, 2, 4]
+
+
+def test_factories_reject_degenerate_configs():
+    with pytest.raises(ValueError):
+        tcn_model(n_features=4, num_blocks=0)
+    with pytest.raises(ValueError):
+        tcn_model(n_features=4, dilations=())
+    with pytest.raises(ValueError):
+        transformer_model(n_features=4, lookback_window=1)
+    with pytest.raises(ValueError):
+        models.TransformerAutoEncoder(kind="transformer_model", lookback_window=1)
+
+
+def test_sequence_estimators_default_lookback_window():
+    model = models.TCNAutoEncoder(kind="tcn_model")
+    assert model.lookback_window == 144
+
+
+def test_ops_attention_importable_standalone():
+    """gordo_tpu.ops.attention as a process's first gordo_tpu import must not
+    trip the ops ↔ models import cycle."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c", "import gordo_tpu.ops.attention; print('ok')"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+# ---------------------------------------------------------------- causality
+def _sequence_output(layers, n_features, x):
+    spec = ModelSpec(
+        layers=layers, n_features=n_features, n_features_out=n_features
+    )
+    params = nn.init_model_params(jax.random.PRNGKey(0), spec)
+    out, _ = nn.apply_model(spec, params, jnp.asarray(x))
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize(
+    "layers",
+    [
+        (TCNBlock(filters=8, kernel_size=3, dilation=2),),
+        (
+            DenseLayer(units=8),
+            TransformerBlock(d_model=8, num_heads=2, ff_dim=16, causal=True),
+        ),
+    ],
+    ids=["tcn", "transformer-causal"],
+)
+def test_causal_layers_ignore_future(layers):
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 12, 4).astype(np.float32)
+    out_a = _sequence_output(layers, 4, x)
+    x_perturbed = x.copy()
+    x_perturbed[:, 8:, :] += 10.0  # change only the future
+    out_b = _sequence_output(layers, 4, x_perturbed)
+    np.testing.assert_allclose(out_a[:, :8], out_b[:, :8], atol=1e-5)
+    assert not np.allclose(out_a[:, 8:], out_b[:, 8:])
+
+
+# --------------------------------------------------------------- estimators
+@pytest.mark.parametrize(
+    "cls,kind,lookahead",
+    [
+        (models.TransformerAutoEncoder, "transformer_model", 0),
+        (models.TransformerForecast, "transformer_model", 1),
+        (models.TCNAutoEncoder, "tcn_model", 0),
+        (models.TCNForecast, "tcn_model", 1),
+    ],
+)
+def test_estimator_fit_predict_window_semantics(cls, kind, lookahead):
+    rng = np.random.RandomState(4)
+    X = rng.rand(40, 3).astype(np.float32)
+    model = cls(
+        kind=kind,
+        lookback_window=8,
+        batch_size=16,
+        epochs=1,
+        d_model=8,
+        num_heads=2,
+        ff_dim=16,
+        num_blocks=1,
+        filters=8,
+    )
+    model.fit(X, X)
+    out = model.predict(X)
+    assert out.shape == (40 - 8 + 1 - lookahead, 3)
+    assert np.all(np.isfinite(out))
+    assert isinstance(model.score(X, X), float)
+
+
+def test_transformer_training_reduces_loss():
+    rng = np.random.RandomState(5)
+    t = np.linspace(0, 20 * np.pi, 300)
+    X = np.stack([np.sin(t), np.cos(t)], axis=1).astype(np.float32)
+    model = models.TransformerAutoEncoder(
+        kind="transformer_model",
+        lookback_window=16,
+        batch_size=32,
+        epochs=15,
+        d_model=16,
+        num_heads=2,
+        ff_dim=32,
+        num_blocks=1,
+    )
+    model.fit(X, X)
+    losses = model.history["loss"]
+    assert losses[-1] < losses[0] * 0.7
+
+
+# -------------------------------------------------------------- serializer
+def test_transformer_round_trips_through_definition():
+    definition = {
+        "gordo_tpu.models.models.TransformerAutoEncoder": {
+            "kind": "transformer_model",
+            "lookback_window": 12,
+            "d_model": 8,
+            "num_heads": 2,
+            "epochs": 1,
+        }
+    }
+    model = from_definition(definition)
+    assert isinstance(model, models.TransformerAutoEncoder)
+    assert model.lookback_window == 12
+    round_tripped = into_definition(model)
+    assert from_definition(round_tripped).get_params() == model.get_params()
+
+
+def test_pickle_fitted_tcn():
+    import pickle
+
+    rng = np.random.RandomState(6)
+    X = rng.rand(30, 2).astype(np.float32)
+    model = models.TCNAutoEncoder(
+        kind="tcn_model", lookback_window=4, epochs=1, filters=4, num_blocks=2
+    )
+    model.fit(X, X)
+    clone = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(clone.predict(X), model.predict(X), atol=1e-6)
