@@ -13,6 +13,10 @@ TPU-first:
 - ``impl="auto"``: flash on TPU when shapes satisfy the kernel's tiling
   constraints, else xla.
 
+Sequence-parallel exact attention for windows too long for one chip (ring
+attention over a mesh axis via shard_map + ppermute) lives in
+:mod:`gordo_tpu.parallel.ring_attention`; it shares this module's blockwise
+online-softmax math.
 """
 
 import os
@@ -61,17 +65,17 @@ def dot_product_attention_xla(
     return jnp.einsum("...qk,...kd->...qd", weights, v)
 
 
-def _flash_ok(q: jnp.ndarray) -> bool:
+def _flash_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     """
     Whether the Pallas flash kernel supports these shapes on this backend.
-    The kernel needs T divisible by its 128-row blocks and a lane-friendly
-    head dim; below ~256 rows the O(T²) XLA path is already VMEM-resident
-    and the kernel buys nothing.
+    The kernel needs self-attention (equal Q/K lengths), T divisible by its
+    128-row blocks, and a lane-friendly head dim; below ~256 rows the O(T²)
+    XLA path is already VMEM-resident and the kernel buys nothing.
     """
     if jax.default_backend() != "tpu":
         return False
     t, dh = q.shape[-2], q.shape[-1]
-    return t >= 256 and t % 128 == 0 and dh % 8 == 0
+    return k.shape[-2] == t and t >= 256 and t % 128 == 0 and dh % 8 == 0
 
 
 def dot_product_attention(
@@ -90,7 +94,7 @@ def dot_product_attention(
     """
     impl = impl or _default_impl()
     if impl == "auto":
-        impl = "flash" if _flash_ok(q) else "xla"
+        impl = "flash" if _flash_ok(q, k) else "xla"
     if impl == "flash":
         from gordo_tpu.ops.pallas_kernels.flash_attention import flash_attention
 

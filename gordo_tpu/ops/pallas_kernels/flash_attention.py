@@ -67,6 +67,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
 def _flash_forward(q, k, v, causal: bool, interpret: bool):
     """q, k, v: (BH, T, Dh) — flattened leading batch*heads axis."""
     bh, t, dh = q.shape
+    if k.shape[1] != t or v.shape[1] != t:
+        # the kernel's key-block loop and causal mask assume start-aligned
+        # self-attention; cross-length attention must use the XLA path
+        raise ValueError(
+            f"flash_attention requires equal Q/K/V sequence lengths, got "
+            f"q={t}, k={k.shape[1]}, v={v.shape[1]}"
+        )
     block_q = min(BLOCK_Q, t)
     block_k = min(BLOCK_K, t)
     if t % block_q or t % block_k:

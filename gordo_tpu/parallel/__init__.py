@@ -13,5 +13,12 @@ SPMD; collectives only appear in the multi-host data path).
 
 from .mesh import default_mesh, machines_sharding
 from .batch_trainer import BatchedModelBuilder
+from .ring_attention import make_ring_attention, sequence_sharding
 
-__all__ = ["default_mesh", "machines_sharding", "BatchedModelBuilder"]
+__all__ = [
+    "default_mesh",
+    "machines_sharding",
+    "BatchedModelBuilder",
+    "make_ring_attention",
+    "sequence_sharding",
+]

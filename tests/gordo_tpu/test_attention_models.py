@@ -237,3 +237,11 @@ def test_pickle_fitted_tcn():
     model.fit(X, X)
     clone = pickle.loads(pickle.dumps(model))
     np.testing.assert_allclose(clone.predict(X), model.predict(X), atol=1e-6)
+
+
+def test_flash_attention_rejects_cross_length_kv():
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 128, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 192, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="equal Q/K/V sequence lengths"):
+        flash_attention(q, k, k, interpret=True)
