@@ -25,9 +25,28 @@ from gordo_tpu import __version__
 _NAME_RE = re.compile(r"/gordo/v0/[^/]+/([^/]+)/")
 
 
+def multiproc_enabled() -> bool:
+    return (
+        "PROMETHEUS_MULTIPROC_DIR" in os.environ
+        or "prometheus_multiproc_dir" in os.environ
+    )
+
+
+def use_multiprocess_values():
+    """Re-evaluate prometheus_client's value backend.
+
+    prometheus_client latches in-memory vs mmap values at import time; call
+    this after setting PROMETHEUS_MULTIPROC_DIR (and after clearing it, to
+    restore in-memory values) so metrics created from then on honor the env.
+    """
+    from prometheus_client import values
+
+    values.ValueClass = values.get_value_class()
+
+
 def create_registry() -> CollectorRegistry:
     registry = CollectorRegistry()
-    if "PROMETHEUS_MULTIPROC_DIR" in os.environ or "prometheus_multiproc_dir" in os.environ:
+    if multiproc_enabled():
         from prometheus_client import multiprocess
 
         multiprocess.MultiProcessCollector(registry)
@@ -46,10 +65,9 @@ class GordoServerPrometheusMetrics:
         # MultiProcessCollector (it reads every worker's mmap files);
         # registering the live metric objects there too would double-count.
         # Metric values still land in the mmap files regardless of registry.
-        multiproc = (
-            "PROMETHEUS_MULTIPROC_DIR" in os.environ
-            or "prometheus_multiproc_dir" in os.environ
-        )
+        multiproc = multiproc_enabled()
+        if multiproc:
+            use_multiprocess_values()
         metric_registry = None if multiproc else self.registry
         self.request_duration = Histogram(
             "gordo_server_request_duration_seconds",
@@ -90,17 +108,3 @@ class GordoServerPrometheusMetrics:
 
     def expose(self) -> bytes:
         return generate_latest(self.registry)
-
-
-def metrics_app(metrics: GordoServerPrometheusMetrics):
-    """Standalone WSGI /metrics app (reference prometheus/server.py:7-27)."""
-
-    def app(environ, start_response):
-        body = metrics.expose()
-        start_response(
-            "200 OK",
-            [("Content-Type", "text/plain; version=0.0.4"), ("Content-Length", str(len(body)))],
-        )
-        return [body]
-
-    return app

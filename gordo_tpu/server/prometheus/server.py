@@ -1,0 +1,75 @@
+"""
+Standalone Prometheus metrics sidecar.
+
+Reference parity: gordo/server/prometheus/server.py:7-27 (a separate app
+exposing /metrics + /healthcheck so the model server's own port stays free
+of scrape traffic) and gordo/server/prometheus/gunicorn_config.py:4-5
+(child_exit → multiprocess.mark_process_dead so a dead worker's mmap'd
+metric files are reaped from the aggregate).
+
+The sidecar reads the same PROMETHEUS_MULTIPROC_DIR the model-server worker
+pool writes to, so it exposes metrics aggregated across every worker
+process without sharing any in-process state with them.
+"""
+
+import logging
+
+from gordo_tpu.server.prometheus.metrics import create_registry
+
+logger = logging.getLogger(__name__)
+
+
+def build_metrics_app():
+    """WSGI app: /metrics (aggregate registry) + /healthcheck."""
+    from prometheus_client import generate_latest
+
+    from gordo_tpu.server.prometheus.metrics import multiproc_enabled
+
+    if not multiproc_enabled():
+        logger.warning(
+            "PROMETHEUS_MULTIPROC_DIR is not set: the sidecar cannot see any "
+            "model-server worker metrics and /metrics will be empty"
+        )
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        if path == "/healthcheck":
+            start_response("200 OK", [("Content-Length", "0")])
+            return [b""]
+        if path == "/metrics":
+            # registry built per scrape: in multiprocess mode the collector
+            # re-reads the worker mmap files, so new workers appear without
+            # a sidecar restart
+            body = generate_latest(create_registry())
+            start_response(
+                "200 OK",
+                [
+                    ("Content-Type", "text/plain; version=0.0.4"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
+        start_response("404 NOT FOUND", [("Content-Length", "0")])
+        return [b""]
+
+    return app
+
+
+def mark_worker_dead(pid: int):
+    """Reap a dead worker's multiprocess metric files (reference
+    gunicorn_config.py child_exit)."""
+    from gordo_tpu.server.prometheus.metrics import multiproc_enabled
+
+    if multiproc_enabled():
+        from prometheus_client import multiprocess
+
+        multiprocess.mark_process_dead(pid)
+        logger.debug("Marked prometheus worker %d dead", pid)
+
+
+def run_metrics_server(host: str = "0.0.0.0", port: int = 5556):
+    """Serve the sidecar with a threaded werkzeug server."""
+    from werkzeug.serving import make_server
+
+    logger.info("Starting prometheus metrics sidecar on %s:%s", host, port)
+    make_server(host, port, build_metrics_app(), threaded=True).serve_forever()

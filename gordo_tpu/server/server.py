@@ -253,6 +253,9 @@ def run_server(
         os.environ["PROMETHEUS_MULTIPROC_DIR"] = tempfile.mkdtemp(
             prefix="gordo-prometheus-"
         )
+        from gordo_tpu.server.prometheus.metrics import use_multiprocess_values
+
+        use_multiprocess_values()
 
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -262,9 +265,38 @@ def run_server(
     logger.info(
         "Starting server on %s:%s with %d worker(s)", host, port, workers
     )
-    for _ in range(workers - 1):
-        if os.fork() == 0:
-            break  # child: fall through to serve on the inherited socket
+    if workers > 1:
+        # reap dead workers and retire their multiprocess metric files
+        # (reference gunicorn child_exit hook, prometheus/gunicorn_config.py);
+        # installed before forking so a worker dying at startup is still
+        # reaped. Only pids in worker_pids are waited on, so exit statuses
+        # of unrelated subprocesses are never stolen from their owners.
+        import signal
+
+        from gordo_tpu.server.prometheus.server import mark_worker_dead
+
+        worker_pids: set = set()
+
+        def _reap(signum, frame):
+            for pid in list(worker_pids):
+                try:
+                    reaped, _status = os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    worker_pids.discard(pid)
+                    continue
+                if reaped == pid:
+                    worker_pids.discard(pid)
+                    mark_worker_dead(pid)
+
+        signal.signal(signal.SIGCHLD, _reap)
+
+        for _ in range(workers - 1):
+            pid = os.fork()
+            if pid == 0:
+                # child: shed the reaper, serve on the inherited socket
+                signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+                break
+            worker_pids.add(pid)
 
     # app built per worker process: model cache and metric values are
     # process-local (metrics aggregate via the multiprocess dir)

@@ -227,6 +227,47 @@ def test_prometheus_custom_registry(model_collection_directory):
     assert b"gordo_server_requests_total" in generate_latest(registry)
 
 
+def test_prometheus_sidecar_app(tmp_path, monkeypatch, model_collection_directory):
+    """Standalone /metrics sidecar aggregates the multiprocess dir
+    (reference prometheus/server.py + gunicorn_config.py)."""
+    from werkzeug.test import Client
+
+    from gordo_tpu.server.prometheus.server import (
+        build_metrics_app,
+        mark_worker_dead,
+    )
+
+    from prometheus_client import values
+
+    monkeypatch.setenv("PROMETHEUS_MULTIPROC_DIR", str(tmp_path))
+    try:
+        # a "worker" records a request into the multiproc dir
+        app = build_app(
+            {
+                "MODEL_COLLECTION_DIR": model_collection_directory,
+                "ENABLE_PROMETHEUS": True,
+                "PROJECT": "side-proj",
+            }
+        )
+        app.test_client().get("/healthcheck")
+
+        sidecar = Client(build_metrics_app())
+        assert sidecar.get("/healthcheck").status_code == 200
+        body = sidecar.get("/metrics").get_data(as_text=True)
+        assert "gordo_server_requests_total" in body
+        assert 'project="side-proj"' in body
+        assert sidecar.get("/nope").status_code == 404
+
+        # reaping a fake dead pid must not raise, /metrics keeps serving
+        mark_worker_dead(999999)
+        assert sidecar.get("/metrics").status_code == 200
+    finally:
+        # restore the in-memory value backend so later tests don't mmap
+        # into this test's (soon-deleted) tmp dir
+        monkeypatch.delenv("PROMETHEUS_MULTIPROC_DIR")
+        values.ValueClass = values.get_value_class()
+
+
 def test_metrics_404_when_disabled(client):
     assert client.get("/metrics").status_code == 404
 
