@@ -1,0 +1,153 @@
+"""
+In-process model-server benchmark.
+
+Reference parity: benchmarks/test_ml_server.py:21-42 — POST 100 samples ×
+n_tags to /prediction and /anomaly/prediction for 100 rounds and report
+latency. pytest-benchmark isn't in the image, so rounds are timed with
+``timeit.default_timer`` and summarized here; payloads are exercised in both
+wire formats (JSON dict and snappy-parquet multipart) since the parquet path
+is what the batch client uses.
+
+Usage: PYTHONPATH=. python benchmarks/bench_server.py [--rounds N] [--samples N]
+Emits one JSON line per (endpoint, format) with p50/p95/mean latency and
+samples/sec.
+"""
+
+import argparse
+import io
+import json
+import os
+import statistics
+import sys
+import tempfile
+import timeit
+
+
+def _build_collection(n_tags: int) -> str:
+    """Train one small model via local_build and dump it server-style."""
+    from gordo_tpu import serializer
+    from gordo_tpu.builder.local_build import local_build
+
+    tags = "".join(f"\n        - bench-tag-{i}" for i in range(n_tags))
+    config = f"""
+machines:
+  - name: bench-machine
+    dataset:
+      tags:{tags}
+      target_tag_list:{tags}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-08T00:00:00+00:00'
+      asset: bench
+      data_provider:
+        type: RandomDataProvider
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        require_thresholds: false
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.models.AutoEncoder:
+                  kind: feedforward_hourglass
+                  epochs: 3
+"""
+    collection = os.path.join(
+        tempfile.mkdtemp(prefix="bench-collection-"), "rev-bench"
+    )
+    model_dir = os.path.join(collection, "bench-machine")
+    os.makedirs(model_dir)
+    ((model, machine),) = local_build(config)
+    serializer.dump(model, model_dir, metadata=machine.to_dict())
+    return collection
+
+
+def _parquet_body(X, y):
+    import pandas as pd
+
+    from gordo_tpu.server.utils import dataframe_into_parquet_bytes
+
+    boundary = "gordobench"
+    parts = []
+    for key, frame in (("X", X), ("y", y)):
+        blob = dataframe_into_parquet_bytes(pd.DataFrame(frame))
+        parts.append(
+            (
+                f'--{boundary}\r\nContent-Disposition: form-data; name="{key}"; '
+                f'filename="{key}.parquet"\r\n'
+                "Content-Type: application/octet-stream\r\n\r\n"
+            ).encode()
+            + blob
+            + b"\r\n"
+        )
+    body = b"".join(parts) + f"--{boundary}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def run(rounds: int, samples: int, n_tags: int) -> int:
+    import numpy as np
+
+    from gordo_tpu.server.server import build_app
+
+    collection = _build_collection(n_tags)
+    app = build_app({"MODEL_COLLECTION_DIR": collection})
+    client = app.test_client()
+
+    rng = np.random.RandomState(0)
+    X = rng.random_sample((samples, n_tags)).tolist()
+    json_payload = json.dumps({"X": X, "y": X}).encode()
+    parquet_body, parquet_ctype = _parquet_body(X, X)
+
+    cases = [
+        ("prediction", "json", json_payload, "application/json"),
+        ("anomaly/prediction", "json", json_payload, "application/json"),
+        ("anomaly/prediction", "parquet", parquet_body, parquet_ctype),
+    ]
+    failures = 0
+    for endpoint, fmt, body, ctype in cases:
+        path = f"/gordo/v0/bench/bench-machine/{endpoint}"
+        # warmup (jit compile + model load)
+        resp = client.post(path, data=body, content_type=ctype)
+        if resp.status_code != 200:
+            print(
+                json.dumps(
+                    {"endpoint": endpoint, "format": fmt, "error": resp.status_code}
+                )
+            )
+            failures += 1
+            continue
+        times = []
+        for _ in range(rounds):
+            start = timeit.default_timer()
+            resp = client.post(path, data=body, content_type=ctype)
+            times.append(timeit.default_timer() - start)
+            assert resp.status_code == 200
+        times.sort()
+        mean = statistics.fmean(times)
+        print(
+            json.dumps(
+                {
+                    "endpoint": endpoint,
+                    "format": fmt,
+                    "rounds": rounds,
+                    "samples_per_post": samples,
+                    "p50_ms": round(times[len(times) // 2] * 1e3, 3),
+                    "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 3),
+                    "mean_ms": round(mean * 1e3, 3),
+                    "samples_per_sec": round(samples / mean, 1),
+                }
+            )
+        )
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--rounds", type=int, default=100)
+    parser.add_argument("--samples", type=int, default=100)
+    parser.add_argument("--tags", type=int, default=4)
+    args = parser.parse_args(argv)
+    return run(args.rounds, args.samples, args.tags)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""
+Threaded load generator against a live model server.
+
+Reference parity: benchmarks/load_test/load_test.py:62-96 — the locust
+harness fetches the deployed server's metadata to learn each model's tag
+list, then drives concurrent prediction POSTs. locust isn't in the image, so
+concurrency comes from a thread pool and results are aggregated here.
+
+Usage:
+    PYTHONPATH=. python benchmarks/load_test.py --host http://localhost:5555 \
+        --project my-project [--machine NAME] [--users 8] [--duration 30]
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def discover(host: str, project: str, machine: str = None):
+    """Learn target machine + its tags from the live server's own API."""
+    if machine is None:
+        models = _get_json(f"{host}/gordo/v0/{project}/models")["models"]
+        if not models:
+            raise SystemExit(f"no models under project {project!r}")
+        machine = models[0]
+    meta = _get_json(f"{host}/gordo/v0/{project}/{machine}/metadata")
+    dataset = meta["metadata"]["dataset"]
+    # same key fallback the server itself applies (server/views.py)
+    raw_tags = dataset.get("tag_list") or dataset.get("tags") or []
+    if not raw_tags:
+        raise SystemExit(f"no tags in metadata for machine {machine!r}")
+    tags = [t["name"] if isinstance(t, dict) else t for t in raw_tags]
+    return machine, tags
+
+
+def worker(url: str, body: bytes, stop_at: float, out: list, errors: list):
+    while time.monotonic() < stop_at:
+        start = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+                if resp.status != 200:
+                    errors.append(resp.status)
+                    continue
+        except Exception as exc:  # noqa: BLE001 — live-server bench, record+go on
+            errors.append(repr(exc))
+            continue
+        out.append(time.monotonic() - start)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", required=True)
+    parser.add_argument("--project", required=True)
+    parser.add_argument("--machine")
+    parser.add_argument("--users", type=int, default=8)
+    parser.add_argument("--duration", type=float, default=30.0)
+    parser.add_argument("--samples", type=int, default=100)
+    args = parser.parse_args(argv)
+
+    machine, tags = discover(args.host, args.project, args.machine)
+    import random
+
+    X = [[random.random() for _ in tags] for _ in range(args.samples)]
+    body = json.dumps({"X": X, "y": X}).encode()
+    url = f"{args.host}/gordo/v0/{args.project}/{machine}/anomaly/prediction"
+
+    # warmup one request so compile/model-load cost isn't in the measurement
+    try:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        urllib.request.urlopen(req, timeout=120).read()
+    except Exception as exc:  # noqa: BLE001
+        print(json.dumps({"error": f"warmup request failed: {exc!r}"}))
+        return 1
+
+    times: list = []
+    errors: list = []
+    stop_at = time.monotonic() + args.duration
+    threads = [
+        threading.Thread(
+            target=worker, args=(url, body, stop_at, times, errors), daemon=True
+        )
+        for _ in range(args.users)
+    ]
+    wall_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - wall_start
+
+    if not times:
+        print(json.dumps({"error": "no successful requests", "errors": errors[:5]}))
+        return 1
+    times.sort()
+    print(
+        json.dumps(
+            {
+                "machine": machine,
+                "users": args.users,
+                "duration_sec": round(wall, 2),
+                "requests": len(times),
+                "errors": len(errors),
+                "req_per_sec": round(len(times) / wall, 2),
+                "samples_per_sec": round(len(times) * args.samples / wall, 1),
+                "p50_ms": round(times[len(times) // 2] * 1e3, 2),
+                "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 2),
+                "mean_ms": round(statistics.fmean(times) * 1e3, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

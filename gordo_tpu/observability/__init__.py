@@ -1,0 +1,5 @@
+from gordo_tpu.observability.grafana import (  # noqa: F401
+    machines_dashboard,
+    servers_dashboard,
+    write_dashboards,
+)

@@ -1,0 +1,309 @@
+"""
+Grafana dashboard generation over the server's Prometheus metrics.
+
+Reference parity: the reference ships two hand-maintained dashboard JSONs
+(resources/grafana/dashboards/Gordo_servers-VictoriaMetrics.json and
+machines.json) over its gordo_server_* metrics. We generate ours from code
+instead — the metric names and label sets live in one place
+(gordo_tpu/server/prometheus/metrics.py), and the dashboards are derived
+from them, so they can't drift apart silently.
+
+Forms follow the data's job: rates and latencies are timeseries panels;
+single current values (replicas, version) are stat panels; latency uses
+histogram_quantile p50/p95 from the duration histogram rather than the
+reference's averages (avg hides tail latency, which is the metric the
+anomaly-serving SLO actually cares about).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# label selector shared by every query; $project is a dashboard variable
+_SEL = 'project=~"$project"'
+
+_PANEL_W = 12
+_PANEL_H = 8
+
+
+def _timeseries(
+    title: str,
+    targets: List[Dict[str, str]],
+    panel_id: int,
+    x: int,
+    y: int,
+    unit: str = "short",
+    description: str = "",
+) -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "type": "timeseries",
+        "title": title,
+        "description": description,
+        "gridPos": {"h": _PANEL_H, "w": _PANEL_W, "x": x, "y": y},
+        "fieldConfig": {
+            "defaults": {
+                "unit": unit,
+                "custom": {
+                    "lineWidth": 2,
+                    "fillOpacity": 0,
+                    "showPoints": "never",
+                    "spanNulls": True,
+                },
+            },
+            "overrides": [],
+        },
+        "options": {
+            "tooltip": {"mode": "multi"},
+            "legend": {"displayMode": "list", "placement": "bottom"},
+        },
+        "targets": [
+            {"expr": t["expr"], "legendFormat": t.get("legend", ""), "refId": chr(65 + i)}
+            for i, t in enumerate(targets)
+        ],
+    }
+
+
+def _stat(
+    title: str,
+    expr: str,
+    panel_id: int,
+    x: int,
+    y: int,
+    unit: str = "short",
+) -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "type": "stat",
+        "title": title,
+        "gridPos": {"h": 4, "w": 6, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "options": {"reduceOptions": {"calcs": ["lastNotNull"]}},
+        "targets": [{"expr": expr, "refId": "A"}],
+    }
+
+
+def _dashboard(
+    title: str, uid: str, panels: List[Dict[str, Any]], extra_vars: Optional[list] = None
+) -> Dict[str, Any]:
+    variables = [
+        {
+            "name": "project",
+            "type": "query",
+            "datasource": None,
+            "query": "label_values(gordo_server_info, project)",
+            "refresh": 2,
+            "includeAll": True,
+            "multi": True,
+        }
+    ] + (extra_vars or [])
+    return {
+        "title": title,
+        "uid": uid,
+        "schemaVersion": 36,
+        "editable": True,
+        "time": {"from": "now-6h", "to": "now"},
+        "refresh": "30s",
+        "templating": {"list": variables},
+        "panels": panels,
+    }
+
+
+def servers_dashboard() -> Dict[str, Any]:
+    """Fleet-level server dashboard (reference Gordo_servers dashboard)."""
+    def latency(q: float) -> str:
+        return (
+            f"histogram_quantile({q}, sum(rate("
+            f"gordo_server_request_duration_seconds_bucket{{{_SEL}}}[5m]"
+            ")) by (le, path))"
+        )
+    panels = [
+        _timeseries(
+            "Requests per path",
+            [
+                {
+                    "expr": f"sum(rate(gordo_server_requests_total{{{_SEL}}}[1m])) by (path)",
+                    "legend": "{{path}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="reqps",
+        ),
+        _timeseries(
+            "Requests per project",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_requests_total"
+                    f"{{{_SEL}}}[1m])) by (project)",
+                    "legend": "{{project}}",
+                }
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            unit="reqps",
+        ),
+        _timeseries(
+            "Requests per minute by status code",
+            [
+                {
+                    "expr": "sum(increase(gordo_server_requests_total"
+                    f"{{{_SEL}}}[1m])) by (status_code)",
+                    "legend": "{{status_code}}",
+                }
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+        ),
+        _timeseries(
+            "API latency p50 / p95 by path",
+            [
+                {"expr": latency(0.5), "legend": "p50 {{path}}"},
+                {"expr": latency(0.95), "legend": "p95 {{path}}"},
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            unit="s",
+            description=(
+                "Tail-aware: histogram_quantile over the duration histogram, "
+                "not an average"
+            ),
+        ),
+        _timeseries(
+            "Anomaly-prediction latency p50 / p95",
+            [
+                {
+                    "expr": (
+                        "histogram_quantile(0.5, sum(rate("
+                        "gordo_server_request_duration_seconds_bucket"
+                        f'{{{_SEL},path=~".*anomaly/prediction"}}[5m]'
+                        ")) by (le))"
+                    ),
+                    "legend": "p50",
+                },
+                {
+                    "expr": (
+                        "histogram_quantile(0.95, sum(rate("
+                        "gordo_server_request_duration_seconds_bucket"
+                        f'{{{_SEL},path=~".*anomaly/prediction"}}[5m]'
+                        ")) by (le))"
+                    ),
+                    "legend": "p95",
+                },
+            ],
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+            unit="s",
+        ),
+        _stat(
+            "Server versions live",
+            f"count(gordo_server_info{{{_SEL}}}) by (version)",
+            panel_id=6,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "Error ratio (5m)",
+            "sum(rate(gordo_server_requests_total"
+            f'{{{_SEL},status_code=~"5.."}}[5m])) / '
+            f"sum(rate(gordo_server_requests_total{{{_SEL}}}[5m]))",
+            panel_id=7,
+            x=_PANEL_W + 6,
+            y=2 * _PANEL_H,
+            unit="percentunit",
+        ),
+    ]
+    return _dashboard("Gordo TPU servers", "gordo-tpu-servers", panels)
+
+
+def machines_dashboard() -> Dict[str, Any]:
+    """Per-machine dashboard (reference machines.json): request rates and
+    latency for one selected model, driven by the gordo_name label."""
+    sel = _SEL + ', gordo_name=~"$machine"'
+    panels = [
+        _timeseries(
+            "Requests per machine",
+            [
+                {
+                    "expr": f"sum(rate(gordo_server_requests_total{{{sel}}}[1m])) "
+                    "by (gordo_name)",
+                    "legend": "{{gordo_name}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="reqps",
+        ),
+        _timeseries(
+            "Latency p95 per machine",
+            [
+                {
+                    "expr": (
+                        "histogram_quantile(0.95, sum(rate("
+                        "gordo_server_request_duration_seconds_bucket"
+                        f"{{{sel}}}[5m])) by (le, gordo_name))"
+                    ),
+                    "legend": "{{gordo_name}}",
+                }
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            unit="s",
+        ),
+        _timeseries(
+            "Status codes per machine",
+            [
+                {
+                    "expr": f"sum(increase(gordo_server_requests_total{{{sel}}}[1m])) "
+                    "by (gordo_name, status_code)",
+                    "legend": "{{gordo_name}} {{status_code}}",
+                }
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+        ),
+    ]
+    machine_var = {
+        "name": "machine",
+        "type": "query",
+        "datasource": None,
+        "query": "label_values(gordo_server_requests_total, gordo_name)",
+        "refresh": 2,
+        "includeAll": True,
+        "multi": True,
+    }
+    return _dashboard(
+        "Gordo TPU machines", "gordo-tpu-machines", panels, extra_vars=[machine_var]
+    )
+
+
+def write_dashboards(out_dir: str) -> List[str]:
+    """Write both dashboards as JSON files into ``out_dir``; returns paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for name, build in (
+        ("gordo_tpu_servers.json", servers_dashboard),
+        ("gordo_tpu_machines.json", machines_dashboard),
+    ):
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as fh:
+            json.dump(build(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    import sys
+
+    target = sys.argv[1] if len(sys.argv) > 1 else "resources/grafana/dashboards"
+    for p in write_dashboards(target):
+        print(p)

@@ -86,6 +86,9 @@ class GordoServerPrometheusMetrics:
             "Server version info",
             ["version", "project"],
             registry=metric_registry,
+            # liveall: dead workers' gauge files are removed by
+            # mark_process_dead, so version counts don't grow forever
+            multiprocess_mode="liveall",
         )
         self.version_info.labels(version=__version__, project=self.project).set(1)
 

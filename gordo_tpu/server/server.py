@@ -290,13 +290,19 @@ def run_server(
 
         signal.signal(signal.SIGCHLD, _reap)
 
+        in_child = False
         for _ in range(workers - 1):
             pid = os.fork()
             if pid == 0:
                 # child: shed the reaper, serve on the inherited socket
                 signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+                in_child = True
                 break
             worker_pids.add(pid)
+        if not in_child:
+            # catch any worker that died before its pid entered worker_pids
+            # (SIGCHLD delivered mid-loop finds an incomplete set)
+            _reap(None, None)
 
     # app built per worker process: model cache and metric values are
     # process-local (metrics aggregate via the multiprocess dir)

@@ -1,0 +1,70 @@
+"""Keep the benchmarks/ harnesses working (reference benchmarks/ dir;
+excluded from its CI too, so here we only run tiny smoke shapes)."""
+
+import json
+import os
+import sys
+import threading
+import wsgiref.simple_server
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+from benchmarks import load_test  # noqa: E402
+from gordo_tpu.server.server import build_app  # noqa: E402
+
+
+class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def live_server(model_collection_directory, trained_model_directories):
+    """Serve the WSGI app over real HTTP in a daemon thread."""
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    server = wsgiref.simple_server.make_server(
+        "127.0.0.1", 0, app, handler_class=_QuietHandler
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_load_test_against_live_server(live_server, gordo_project, capsys):
+    rc = load_test.main(
+        [
+            "--host",
+            live_server,
+            "--project",
+            gordo_project,
+            "--users",
+            "2",
+            "--duration",
+            "2",
+            "--samples",
+            "10",
+        ]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["requests"] > 0
+    assert report["errors"] == 0
+    assert report["p95_ms"] >= report["p50_ms"]
+
+
+def test_load_test_discover(live_server, gordo_project, gordo_name, sensors):
+    machine, tags = load_test.discover(live_server, gordo_project)
+    assert machine == gordo_name
+    assert tags == [t.name for t in sensors]
+
+
+def test_bench_server_smoke(monkeypatch):
+    """Two-round bench run end-to-end (builds its own tiny model)."""
+    from benchmarks import bench_server
+
+    assert bench_server.run(rounds=2, samples=10, n_tags=2) == 0

@@ -1,0 +1,90 @@
+"""Grafana dashboard generation (reference resources/grafana/dashboards)."""
+
+import json
+import re
+
+from gordo_tpu.observability import (
+    machines_dashboard,
+    servers_dashboard,
+    write_dashboards,
+)
+from gordo_tpu.server.prometheus import metrics as server_metrics
+
+
+def _all_exprs(dash):
+    for panel in dash["panels"]:
+        for target in panel["targets"]:
+            yield target["expr"]
+
+
+def test_dashboards_reference_live_metric_names():
+    """Every metric a dashboard queries must be one the server exports,
+    so the dashboards can't silently drift from the metrics module."""
+    exported = {
+        "gordo_server_request_duration_seconds",
+        "gordo_server_requests_total",
+        "gordo_server_info",
+    }
+    # the exported set itself must match what metrics.py registers
+    src = open(server_metrics.__file__).read()
+    for name in exported:
+        assert f'"{name}"' in src, name
+
+    suffix = r"(?:_bucket|_count|_sum)?"
+    metric_re = re.compile(r"(gordo_server_[a-z_]+?)" + suffix + r"\{")
+    for dash in (servers_dashboard(), machines_dashboard()):
+        for expr in _all_exprs(dash):
+            names = metric_re.findall(expr)
+            assert names, expr
+            for name in names:
+                base = re.sub(r"_(bucket|count|sum)$", "", name)
+                assert base in exported, (base, expr)
+
+
+def test_dashboard_structure():
+    for dash in (servers_dashboard(), machines_dashboard()):
+        ids = [p["id"] for p in dash["panels"]]
+        assert len(ids) == len(set(ids))
+        assert dash["uid"]
+        var_names = [v["name"] for v in dash["templating"]["list"]]
+        assert "project" in var_names
+        for panel in dash["panels"]:
+            assert panel["type"] in ("timeseries", "stat")
+            # single y-scale: no overrides introducing a second axis
+            assert panel["fieldConfig"]["overrides"] == []
+
+
+def test_latency_panels_use_quantiles_not_averages():
+    dash = servers_dashboard()
+    latency_exprs = [
+        e for e in _all_exprs(dash) if "request_duration_seconds_bucket" in e
+    ]
+    assert latency_exprs
+    for expr in latency_exprs:
+        assert "histogram_quantile" in expr
+
+
+def test_write_dashboards_roundtrip(tmp_path):
+    paths = write_dashboards(str(tmp_path))
+    assert len(paths) == 2
+    for path in paths:
+        with open(path) as fh:
+            dash = json.load(fh)
+        assert dash["panels"]
+
+
+def test_checked_in_dashboards_are_current():
+    """resources/grafana/dashboards must match the generator output."""
+    import os
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    out_dir = os.path.join(repo_root, "resources", "grafana", "dashboards")
+    for name, build in (
+        ("gordo_tpu_servers.json", servers_dashboard),
+        ("gordo_tpu_machines.json", machines_dashboard),
+    ):
+        with open(os.path.join(out_dir, name)) as fh:
+            assert json.load(fh) == build(), f"{name} is stale — regenerate with " \
+                "python -m gordo_tpu.observability.grafana"
