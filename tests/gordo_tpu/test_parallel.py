@@ -177,3 +177,43 @@ def test_unsupported_metric_goes_serial():
     _, machine_out = results[0]
     scores = machine_out.metadata.build_metadata.model.cross_validation.scores
     assert any("max-error" in k for k in scores)
+
+
+def test_rolling_min_max_matches_pandas():
+    """The numpy threshold math must equal pandas rolling(w).min().max()
+    (the formula the serial DiffBasedAnomalyDetector uses, ref diff.py)."""
+    rng = np.random.RandomState(7)
+    for n, w in [(200, 6), (144, 144), (50, 6), (5, 6), (6, 6)]:
+        series = rng.rand(n)
+        expected = pd.Series(series).rolling(w).min().max()
+        got = BatchedModelBuilder._rolling_min_max(series, w)
+        if np.isnan(expected):
+            assert np.isnan(got)
+        else:
+            assert np.isclose(got, expected)
+
+        frame = rng.rand(n, 4)
+        expected_df = pd.DataFrame(frame).rolling(w).min().max()
+        got_df = BatchedModelBuilder._rolling_min_max(frame, w)
+        assert np.allclose(
+            np.asarray(got_df), expected_df.to_numpy(), equal_nan=True
+        )
+
+
+def test_chunked_build_matches_unchunked():
+    """Chunking is an execution detail: results must be identical for any
+    chunk size (same seeds, same data)."""
+    import jax
+
+    cfg = "machines:" + "".join(_machine_block(f"ck-{i}") for i in range(3))
+    small = BatchedModelBuilder(_machines(cfg), chunk_size=1).build()
+    big = BatchedModelBuilder(_machines(cfg), chunk_size=64).build()
+    for (m_small, _), (m_big, _) in zip(small, big):
+        a = m_small.base_estimator.steps[-1][1].params_
+        b = m_big.base_estimator.steps[-1][1].params_
+        for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+            assert np.allclose(np.asarray(la), np.asarray(lb))
+    # thresholds identical too (assembly independent of chunking)
+    assert np.isclose(
+        small[0][0].aggregate_threshold_, big[0][0].aggregate_threshold_
+    )
