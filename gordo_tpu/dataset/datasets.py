@@ -149,6 +149,71 @@ class TimeSeriesDataset(GordoBaseDataset):
         )
 
     # ------------------------------------------------------------------ data
+    def _native_resample(self, series: pd.Series) -> Optional[dict]:
+        """
+        One-pass C++ bucket aggregation (gordo_tpu.native) matching
+        ``series.resample(resolution).agg(aggregation_methods)``.
+
+        Returns {column_suffix_or_None: pd.Series} or None when the input is
+        outside the native kernel's contract (non-fixed frequency, exotic
+        aggregation, unsorted/foreign index) — the caller then uses pandas.
+        """
+        from gordo_tpu import native
+
+        if not native.available() or len(series) == 0:
+            return None
+        if not isinstance(series.index, pd.DatetimeIndex):
+            return None
+        methods = (
+            [self.aggregation_methods]
+            if isinstance(self.aggregation_methods, str)
+            else list(self.aggregation_methods)
+        )
+        if any(not isinstance(m, str) or m not in native.AGG_CODES for m in methods):
+            return None
+        try:
+            bucket = pd.tseries.frequencies.to_offset(self.resolution).nanos
+        except ValueError:
+            return None  # calendar-dependent frequency (months etc.)
+        if not series.index.is_monotonic_increasing:
+            return None
+        tz = series.index.tz
+        if tz is not None and str(tz) not in ("UTC", "utc"):
+            # pandas' 'start_day' origin is midnight in the index's own tz;
+            # only the UTC/naive cases are reproduced here
+            return None
+
+        # asi8 is in the index's own resolution (pandas 2 supports s/ms/us
+        # units); normalize to nanoseconds first
+        ts_ns = series.index.as_unit("ns").asi8
+        # pandas resample origin: 'start_day' = midnight of the first
+        # sample's day; buckets are left-closed, left-labeled
+        day_ns = 86_400_000_000_000
+        origin = ts_ns[0] - (ts_ns[0] % day_ns)
+        first_bucket = (ts_ns[0] - origin) // bucket
+        last_bucket = (ts_ns[-1] - origin) // bucket
+        n_buckets = int(last_bucket - first_bucket + 1)
+        origin_ns = int(origin + first_bucket * bucket)
+
+        out = native.resample(
+            ts_ns, series.to_numpy(np.float64), origin_ns, bucket, n_buckets, methods
+        )
+        index = pd.DatetimeIndex(
+            origin_ns + bucket * np.arange(n_buckets),
+            tz=series.index.tz,
+            freq=pd.tseries.frequencies.to_offset(self.resolution),
+        ).as_unit(series.index.unit)
+        def _col(i: int, method: str) -> pd.Series:
+            vals = out[i]
+            if method == "count":
+                # pandas count is int64 (count of non-NaN samples)
+                vals = vals.astype(np.int64)
+            return pd.Series(vals, index=index)
+
+        if isinstance(self.aggregation_methods, str):
+            return {None: _col(0, methods[0])}
+        return {m: _col(i, m) for i, m in enumerate(methods)}
+
     def _join_series(self) -> pd.DataFrame:
         t0 = time.monotonic()
         all_tags = list(dict.fromkeys(self.tag_list + self.target_tag_list))
@@ -159,6 +224,12 @@ class TimeSeriesDataset(GordoBaseDataset):
         )
         frames = {}
         for tag, series in zip(all_tags, series_iter):
+            native_out = self._native_resample(series)
+            if native_out is not None:
+                for method, col in native_out.items():
+                    key = tag.name if method is None else f"{tag.name}_{method}"
+                    frames[key] = col
+                continue
             resampled = series.resample(self.resolution).agg(self.aggregation_methods)
             if isinstance(resampled, pd.DataFrame):
                 # multiple aggregation methods: one column per (tag, method)

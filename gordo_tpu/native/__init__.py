@@ -1,0 +1,185 @@
+"""
+Native (C++) data-layer kernels, bound via ctypes.
+
+The shared library is compiled on demand with g++ from the source shipped in
+this package (no pybind11 in the image; plain ``extern "C"`` + ctypes). The
+build artifact is cached under ``$GORDO_TPU_NATIVE_CACHE`` (default
+``~/.cache/gordo_tpu``) keyed by a source hash, so a source change triggers
+exactly one rebuild. Everything degrades gracefully: if g++ is missing, the
+build fails, or ``$GORDO_TPU_NO_NATIVE`` is set, ``available()`` returns
+False and callers use their pure-numpy/pandas fallbacks.
+
+Reference context: the reference's data layer is the gordo-dataset pip
+package (pandas resample/join per tag, SURVEY.md L0); there is no native
+code anywhere in the reference, so this is a capability superset driven by
+the batched trainer's host-side profile.
+"""
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "gordo_native.cpp")
+
+AGG_CODES = {
+    "mean": 0,
+    "min": 1,
+    "max": 2,
+    "sum": 3,
+    "count": 4,
+    "median": 5,
+}
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "GORDO_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "gordo_tpu"),
+    )
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as fh:
+        src = fh.read()
+    digest = hashlib.sha256(src).hexdigest()[:16]
+    out_dir = _cache_dir()
+    so_path = os.path.join(out_dir, f"gordo_native-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(out_dir, exist_ok=True)
+    tmp_path = so_path + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        _SRC,
+        "-o",
+        tmp_path,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        logger.warning("native build failed to run: %r", exc)
+        return None
+    if proc.returncode != 0:
+        logger.warning(
+            "native build failed (rc=%d): %s",
+            proc.returncode,
+            proc.stderr.decode(errors="replace")[:2000],
+        )
+        return None
+    os.replace(tmp_path, so_path)  # atomic: concurrent builders race safely
+    return so_path
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("GORDO_TPU_NO_NATIVE"):
+            _load_failed = True
+            return None
+        so_path = _build()
+        if so_path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as exc:
+            logger.warning("native library load failed: %r", exc)
+            _load_failed = True
+            return None
+        lib.gordo_resample.restype = ctypes.c_int32
+        lib.gordo_resample.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.gordo_rolling_min_max.restype = ctypes.c_double
+        lib.gordo_rolling_min_max.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is importable (builds it on first call)."""
+    return _load() is not None
+
+
+def resample(
+    ts_ns: np.ndarray,
+    values: np.ndarray,
+    origin_ns: int,
+    bucket_ns: int,
+    n_buckets: int,
+    methods: List[str],
+) -> np.ndarray:
+    """
+    Bucket-aggregate (timestamp, value) samples.
+
+    Returns array [len(methods), n_buckets] with pandas
+    ``resample(...).agg(method)`` semantics (left-closed buckets, skipna).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    ts_ns = np.ascontiguousarray(ts_ns, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    aggs = np.array([AGG_CODES[m] for m in methods], dtype=np.int32)
+    out = np.empty((len(methods), n_buckets), dtype=np.float64)
+    rc = lib.gordo_resample(
+        ts_ns.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(ts_ns),
+        origin_ns,
+        bucket_ns,
+        n_buckets,
+        aggs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(methods),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        raise ValueError(f"gordo_resample failed with code {rc}")
+    return out
+
+
+def rolling_min_max(values: np.ndarray, window: int) -> float:
+    """pandas ``Series.rolling(window).min().max()`` as one native pass."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    return float(
+        lib.gordo_rolling_min_max(
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(values),
+            window,
+        )
+    )

@@ -1,0 +1,167 @@
+// Native data-layer kernels for the timeseries pipeline.
+//
+// The reference's data layer (gordo-dataset) does per-tag resample/aggregate
+// joins in pandas; at fleet scale (1000+ machines x N tags) the pandas
+// object overhead dominates the host-side cost of a batched TPU build.
+// These kernels do the same time-bucket aggregation in one pass over the
+// raw (timestamp, value) arrays.
+//
+// Aggregation semantics match pandas Series.resample(freq).agg(method) with
+// the default closed='left', label='left' bucketing: a sample at time t
+// lands in bucket floor((t - origin) / bucket). NaN values are skipped
+// (pandas skipna): empty buckets give NaN for mean/min/max/median, 0 for
+// sum/count.
+//
+// Built with plain g++ -O3 -shared -fPIC; bound via ctypes (no pybind11 in
+// the image). All symbols are extern "C".
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+
+const double kNaN = std::numeric_limits<double>::quiet_NaN();
+
+enum Agg : int32_t {
+  kMean = 0,
+  kMin = 1,
+  kMax = 2,
+  kSum = 3,
+  kCount = 4,
+  kMedian = 5,
+};
+
+}  // namespace
+
+extern "C" {
+
+// Single-pass bucket aggregation.
+//   ts_ns:     sample timestamps (ns since epoch), ascending
+//   vals:      sample values (may contain NaN)
+//   n:         number of samples
+//   origin_ns: left edge of bucket 0
+//   bucket_ns: bucket width
+//   n_buckets: number of output buckets
+//   aggs:      aggregation codes (see Agg), length n_aggs
+//   out:       [n_aggs][n_buckets] row-major output
+// Returns 0 on success, nonzero on invalid input.
+int32_t gordo_resample(const int64_t* ts_ns, const double* vals, int64_t n,
+                       int64_t origin_ns, int64_t bucket_ns, int64_t n_buckets,
+                       const int32_t* aggs, int32_t n_aggs, double* out) {
+  if (bucket_ns <= 0 || n_buckets < 0 || n_aggs <= 0) return 1;
+
+  bool need_median = false;
+  for (int32_t a = 0; a < n_aggs; ++a) {
+    if (aggs[a] < kMean || aggs[a] > kMedian) return 2;
+    if (aggs[a] == kMedian) need_median = true;
+  }
+
+  std::vector<double> sum(n_buckets, 0.0);
+  std::vector<double> mn(n_buckets, kNaN);
+  std::vector<double> mx(n_buckets, kNaN);
+  std::vector<int64_t> cnt(n_buckets, 0);
+  // per-bucket values only gathered when median is requested
+  std::vector<std::vector<double>> per_bucket;
+  if (need_median) per_bucket.resize(n_buckets);
+
+  for (int64_t i = 0; i < n; ++i) {
+    const double v = vals[i];
+    if (std::isnan(v)) continue;
+    const int64_t rel = ts_ns[i] - origin_ns;
+    if (rel < 0) continue;
+    const int64_t b = rel / bucket_ns;
+    if (b >= n_buckets) continue;
+    sum[b] += v;
+    if (cnt[b] == 0) {
+      mn[b] = v;
+      mx[b] = v;
+    } else {
+      mn[b] = std::min(mn[b], v);
+      mx[b] = std::max(mx[b], v);
+    }
+    ++cnt[b];
+    if (need_median) per_bucket[b].push_back(v);
+  }
+
+  for (int32_t a = 0; a < n_aggs; ++a) {
+    double* row = out + static_cast<int64_t>(a) * n_buckets;
+    switch (aggs[a]) {
+      case kMean:
+        for (int64_t b = 0; b < n_buckets; ++b)
+          row[b] = cnt[b] ? sum[b] / static_cast<double>(cnt[b]) : kNaN;
+        break;
+      case kMin:
+        for (int64_t b = 0; b < n_buckets; ++b) row[b] = mn[b];
+        break;
+      case kMax:
+        for (int64_t b = 0; b < n_buckets; ++b) row[b] = mx[b];
+        break;
+      case kSum:
+        for (int64_t b = 0; b < n_buckets; ++b) row[b] = sum[b];
+        break;
+      case kCount:
+        for (int64_t b = 0; b < n_buckets; ++b)
+          row[b] = static_cast<double>(cnt[b]);
+        break;
+      case kMedian:
+        for (int64_t b = 0; b < n_buckets; ++b) {
+          std::vector<double>& pb = per_bucket[b];
+          if (pb.empty()) {
+            row[b] = kNaN;
+            continue;
+          }
+          const size_t mid = pb.size() / 2;
+          std::nth_element(pb.begin(), pb.begin() + mid, pb.end());
+          double hi = pb[mid];
+          if (pb.size() % 2 == 1) {
+            row[b] = hi;
+          } else {
+            double lo = *std::max_element(pb.begin(), pb.begin() + mid);
+            row[b] = 0.5 * (lo + hi);
+          }
+        }
+        break;
+    }
+  }
+  return 0;
+}
+
+// Rolling-min-then-global-max (threshold math: pandas rolling(w).min().max()).
+//   vals: [n] input; returns NaN when n < w. Monotonic-deque sliding minimum,
+//   O(n) for any window size.
+double gordo_rolling_min_max(const double* vals, int64_t n, int64_t w) {
+  if (w <= 0 || n < w) return kNaN;
+  std::vector<int64_t> deque(n);
+  int64_t head = 0, tail = 0;  // deque[head..tail) holds candidate indices
+  double best = kNaN;
+  bool any = false;
+  // pandas rolling(w).min() yields NaN for any window containing a NaN
+  // (min_periods defaults to the window size), and NaN windows never
+  // contribute to the max — so a window only counts when the trailing
+  // run of non-NaN values is at least w long
+  int64_t run = 0;  // consecutive non-NaN count ending at i
+  for (int64_t i = 0; i < n; ++i) {
+    if (std::isnan(vals[i])) {
+      run = 0;
+      head = tail = 0;
+      continue;
+    }
+    ++run;
+    while (tail > head && vals[deque[tail - 1]] >= vals[i]) --tail;
+    deque[tail++] = i;
+    while (deque[head] <= i - w) ++head;
+    if (run >= w) {
+      const double wmin = vals[deque[head]];
+      if (!any || wmin > best) {
+        best = wmin;
+        any = true;
+      }
+    }
+  }
+  return any ? best : kNaN;
+}
+
+}  // extern "C"

@@ -582,9 +582,19 @@ class BatchedModelBuilder:
 
     @staticmethod
     def _rolling_min_max(a: np.ndarray, window: int):
-        """pandas ``rolling(window).min().max()`` in numpy: max over sliding
-        minima (NaN rows before the window fills never exceed any max). For a
-        2D array the reduction is per column; returns scalar for 1D input."""
+        """pandas ``rolling(window).min().max()``: max over sliding-window
+        minima, where a window containing NaN has a NaN min and the final
+        max skips NaN windows (pandas skipna). Uses the O(n) native kernel
+        when built; numpy sliding-window fallback otherwise. For a 2D array
+        the reduction is per column; returns scalar for 1D input."""
+        from gordo_tpu import native
+
+        if native.available():
+            if a.ndim == 1:
+                return native.rolling_min_max(a, window)
+            return np.array(
+                [native.rolling_min_max(a[:, d], window) for d in range(a.shape[1])]
+            )
         if a.shape[0] < window:
             return (
                 np.nan if a.ndim == 1 else np.full(a.shape[1:], np.nan)
@@ -592,7 +602,13 @@ class BatchedModelBuilder:
         mins = np.lib.stride_tricks.sliding_window_view(a, window, axis=0).min(
             axis=-1
         )
-        return mins.max(axis=0)
+        # nanmax skips NaN windows (pandas skipna); it warns on all-NaN
+        # slices, where the NaN result is exactly what pandas returns
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", RuntimeWarning)
+            return np.nanmax(mins, axis=0)
 
     def _set_thresholds(self, detector, plan, fold_preds, fold_bounds):
         """Replicate DiffBasedAnomalyDetector.cross_validate's threshold math

@@ -1,0 +1,143 @@
+"""
+Native C++ kernels: numerical parity with the pandas operations they replace.
+
+The contract is exact equality of semantics with
+``Series.resample(freq).agg(method)`` (left-closed/left-labeled buckets,
+start_day origin, skipna) and ``Series.rolling(w).min().max()``.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu import native
+from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def _random_series(rng, n, freq_s=60, irregular=True, nan_frac=0.1, tz="UTC"):
+    base = pd.Timestamp("2019-01-01T00:07:00", tz=tz)
+    if irregular:
+        deltas = np.cumsum(rng.randint(1, 3 * freq_s, size=n))
+    else:
+        deltas = np.arange(n) * freq_s
+    index = base + pd.to_timedelta(deltas, unit="s")
+    values = rng.randn(n)
+    if nan_frac:
+        values[rng.rand(n) < nan_frac] = np.nan
+    return pd.Series(values, index=index)
+
+
+@pytest.mark.parametrize("method", ["mean", "min", "max", "sum", "count", "median"])
+@pytest.mark.parametrize("irregular", [True, False])
+def test_resample_matches_pandas(method, irregular):
+    rng = np.random.RandomState(hash(method) % 2**31)
+    series = _random_series(rng, 500, irregular=irregular)
+    expected = series.resample("10min").agg(method)
+
+    bucket = pd.tseries.frequencies.to_offset("10min").nanos
+    ts_ns = series.index.as_unit("ns").asi8
+    day_ns = 86_400_000_000_000
+    origin = ts_ns[0] - (ts_ns[0] % day_ns)
+    first = (ts_ns[0] - origin) // bucket
+    last = (ts_ns[-1] - origin) // bucket
+    n_buckets = int(last - first + 1)
+    origin_ns = int(origin + first * bucket)
+
+    out = native.resample(
+        ts_ns, series.to_numpy(np.float64), origin_ns, bucket, n_buckets, [method]
+    )[0]
+    assert len(out) == len(expected)
+    np.testing.assert_allclose(out, expected.to_numpy(np.float64), equal_nan=True)
+    # bucket labels line up too
+    assert int(expected.index.as_unit("ns").asi8[0]) == origin_ns
+
+
+def test_resample_multi_agg_single_pass():
+    rng = np.random.RandomState(0)
+    series = _random_series(rng, 300)
+    methods = ["mean", "max", "count"]
+    expected = series.resample("10min").agg(methods)
+
+    bucket = pd.tseries.frequencies.to_offset("10min").nanos
+    ts_ns = series.index.as_unit("ns").asi8
+    day_ns = 86_400_000_000_000
+    origin = ts_ns[0] - (ts_ns[0] % day_ns)
+    first = (ts_ns[0] - origin) // bucket
+    n_buckets = int((ts_ns[-1] - origin) // bucket - first + 1)
+    out = native.resample(
+        ts_ns,
+        series.to_numpy(np.float64),
+        int(origin + first * bucket),
+        bucket,
+        n_buckets,
+        methods,
+    )
+    for i, m in enumerate(methods):
+        np.testing.assert_allclose(
+            out[i], expected[m].to_numpy(np.float64), equal_nan=True
+        )
+
+
+@pytest.mark.parametrize("w", [1, 6, 50, 144])
+def test_rolling_min_max_matches_pandas(w):
+    rng = np.random.RandomState(w)
+    for n in [w - 1, w, w + 1, 500]:
+        if n <= 0:
+            continue
+        vals = rng.randn(n)
+        vals[rng.rand(n) < 0.05] = np.nan
+        expected = pd.Series(vals).rolling(w).min().max()
+        got = native.rolling_min_max(vals, w)
+        if np.isnan(expected):
+            assert np.isnan(got)
+        else:
+            assert np.isclose(got, expected)
+
+
+def test_dataset_native_path_matches_pandas_path(monkeypatch):
+    """TimeSeriesDataset output must be identical with the native resampler
+    on and off."""
+    cfg = dict(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-04T00:00:00+00:00",
+        tags=["native-a", "native-b"],
+        data_provider={"type": "RandomDataProvider"},
+    )
+    X_native, y_native = TimeSeriesDataset(**cfg).get_data()
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", True)
+    X_pandas, y_pandas = TimeSeriesDataset(**cfg).get_data()
+
+    pd.testing.assert_frame_equal(X_native, X_pandas)
+    pd.testing.assert_frame_equal(y_native, y_pandas)
+
+
+def test_dataset_native_path_multi_agg(monkeypatch):
+    cfg = dict(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-01-03T00:00:00+00:00",
+        tags=["nm-a"],
+        aggregation_methods=["mean", "max", "count"],
+        data_provider={"type": "RandomDataProvider"},
+    )
+    X_native, _ = TimeSeriesDataset(**cfg).get_data()
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", True)
+    X_pandas, _ = TimeSeriesDataset(**cfg).get_data()
+    # exact parity including the int64 dtype of count columns
+    pd.testing.assert_frame_equal(X_native, X_pandas)
+
+
+def test_no_native_env_kill_switch(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    monkeypatch.setenv("GORDO_TPU_NO_NATIVE", "1")
+    assert not native.available()
+    # reset for other tests
+    monkeypatch.delenv("GORDO_TPU_NO_NATIVE")
+    monkeypatch.setattr(native, "_load_failed", False)

@@ -179,25 +179,37 @@ def test_unsupported_metric_goes_serial():
     assert any("max-error" in k for k in scores)
 
 
-def test_rolling_min_max_matches_pandas():
-    """The numpy threshold math must equal pandas rolling(w).min().max()
-    (the formula the serial DiffBasedAnomalyDetector uses, ref diff.py)."""
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_rolling_min_max_matches_pandas(force_numpy, monkeypatch):
+    """The threshold math (native kernel and numpy fallback) must equal
+    pandas rolling(w).min().max() — including NaN inputs, where a window
+    containing NaN has NaN min and the final max skips NaN windows."""
+    if force_numpy:
+        from gordo_tpu import native
+
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", True)
     rng = np.random.RandomState(7)
     for n, w in [(200, 6), (144, 144), (50, 6), (5, 6), (6, 6)]:
-        series = rng.rand(n)
-        expected = pd.Series(series).rolling(w).min().max()
-        got = BatchedModelBuilder._rolling_min_max(series, w)
-        if np.isnan(expected):
-            assert np.isnan(got)
-        else:
-            assert np.isclose(got, expected)
+        for nan_frac in (0.0, 0.15, 1.0):
+            series = rng.rand(n)
+            if nan_frac:
+                series[rng.rand(n) < nan_frac] = np.nan
+            expected = pd.Series(series).rolling(w).min().max()
+            got = BatchedModelBuilder._rolling_min_max(series, w)
+            if np.isnan(expected):
+                assert np.isnan(got), (n, w, nan_frac)
+            else:
+                assert np.isclose(got, expected), (n, w, nan_frac)
 
-        frame = rng.rand(n, 4)
-        expected_df = pd.DataFrame(frame).rolling(w).min().max()
-        got_df = BatchedModelBuilder._rolling_min_max(frame, w)
-        assert np.allclose(
-            np.asarray(got_df), expected_df.to_numpy(), equal_nan=True
-        )
+            frame = rng.rand(n, 4)
+            if nan_frac:
+                frame[rng.rand(n, 4) < nan_frac] = np.nan
+            expected_df = pd.DataFrame(frame).rolling(w).min().max()
+            got_df = BatchedModelBuilder._rolling_min_max(frame, w)
+            assert np.allclose(
+                np.asarray(got_df), expected_df.to_numpy(), equal_nan=True
+            ), (n, w, nan_frac)
 
 
 def test_chunked_build_matches_unchunked():
