@@ -17,6 +17,7 @@ import statistics
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 
@@ -51,9 +52,10 @@ def worker(url: str, body: bytes, stop_at: float, out: list, errors: list):
             )
             with urllib.request.urlopen(req, timeout=60) as resp:
                 resp.read()
-                if resp.status != 200:
-                    errors.append(resp.status)
-                    continue
+        except urllib.error.HTTPError as exc:
+            # non-2xx raises; record the status code, not the exception repr
+            errors.append(exc.code)
+            continue
         except Exception as exc:  # noqa: BLE001 — live-server bench, record+go on
             errors.append(repr(exc))
             continue

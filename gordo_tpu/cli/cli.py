@@ -26,7 +26,7 @@ import click
 import jinja2
 import yaml
 
-from gordo_tpu import __version__, serializer
+from gordo_tpu import __version__, native, serializer
 from gordo_tpu.builder import ModelBuilder
 from gordo_tpu.dataset.datasets import InsufficientDataError
 from gordo_tpu.dataset.sensor_tag import SensorTagNormalizationError
@@ -153,6 +153,9 @@ def build(
 ):
     """Build a model for a single machine and deposit it into output_dir."""
     try:
+        # Compile the native data-layer kernels now (cache-hit after the
+        # first pod) instead of stalling mid-build on first use.
+        native.prebuild(block=True)
         if model_parameter and isinstance(machine_config["model"], str):
             parameters = dict(model_parameter)
             machine_config["model"] = expand_model(
@@ -233,6 +236,7 @@ def batch_build(
     from gordo_tpu.parallel import BatchedModelBuilder
     from gordo_tpu.workflow.normalized_config import NormalizedConfig
 
+    native.prebuild(block=True)
     with open(config_file) as f:
         config = yaml.safe_load(f)
     norm = NormalizedConfig(config, project_name=project_name)

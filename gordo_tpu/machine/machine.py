@@ -1,11 +1,14 @@
 """
-The Machine domain object: one industrial asset = one model to build.
+``Machine``: the unit of work in a gordo-tpu project — one industrial asset,
+one dataset slice, one model to train and serve.
 
-Reference parity: gordo/machine/machine.py:27-224 — same fields
-(name/model/dataset/runtime/evaluation/metadata/project_name), same
-global-config patching semantics in ``from_config`` (globals patch the
-machine's dataset; the machine's runtime/evaluation patch the globals), same
-reporter dispatch and numpy/datetime-safe JSON encoder.
+Config semantics are a wire contract with the reference
+(gordo/machine/machine.py:27-224): a machine block merged with the project
+``globals`` block must produce the same effective name / model / dataset /
+runtime / evaluation / metadata, and ``to_dict``/``from_dict`` must
+round-trip.  The expression here is our own: merge policy is declared as a
+table, field coercion lives in small helpers, and the JSON encoder is a
+dispatch list.
 """
 
 import json
@@ -29,10 +32,43 @@ from gordo_tpu.workflow.helpers import patch_dict
 
 logger = logging.getLogger(__name__)
 
+# How each layered section of a machine config merges with the project
+# ``globals`` block.  "machine" wins means the machine block's keys override
+# the global defaults; "globals" wins is the reverse (the project forces the
+# dataset window/provider onto every machine unless it says otherwise).
+_MERGE_POLICY = {
+    "runtime": "machine",
+    "evaluation": "machine",
+    "dataset": "globals",
+}
+
+
+def _merged_section(section: str, machine_cfg: dict, globals_cfg: dict) -> dict:
+    """Overlay one config section per ``_MERGE_POLICY``."""
+    local = machine_cfg.get(section) or {}
+    shared = globals_cfg.get(section) or {}
+    if _MERGE_POLICY[section] == "machine":
+        return patch_dict(shared, local)
+    return patch_dict(local, shared)
+
+
+def _as_dataset(value: Union[GordoBaseDataset, dict]) -> GordoBaseDataset:
+    if isinstance(value, GordoBaseDataset):
+        return value
+    return GordoBaseDataset.from_dict(value)
+
+
+def _as_metadata(value: Union[Metadata, dict]) -> Metadata:
+    if isinstance(value, Metadata):
+        return value
+    return Metadata.from_dict(value)
+
 
 class Machine:
-    """Represents a single machine in a config file."""
+    """One machine block from a project config, validated and coerced."""
 
+    # Descriptor-validated fields: assignment runs the k8s-name / model /
+    # runtime checks at construction time, so a bad config fails fast.
     name = ValidUrlString()
     project_name = ValidUrlString()
     host = ValidUrlString()
@@ -52,26 +88,16 @@ class Machine:
         metadata: Optional[Union[dict, Metadata]] = None,
         runtime=None,
     ):
-        if runtime is None:
-            runtime = dict()
-        if evaluation is None:
-            evaluation = dict(cv_mode="full_build")
-        if metadata is None:
-            metadata = dict()
         self.name = name
-        self.model = model
-        self.dataset = (
-            dataset
-            if isinstance(dataset, GordoBaseDataset)
-            else GordoBaseDataset.from_dict(dataset)
-        )
-        self.runtime = runtime
-        self.evaluation = evaluation
-        self.metadata = (
-            metadata if isinstance(metadata, Metadata) else Metadata.from_dict(metadata)
-        )
         self.project_name = project_name
-        self.host = f"gordoserver-{self.project_name}-{self.name}"
+        self.model = model
+        self.dataset = _as_dataset(dataset)
+        self.runtime = {} if runtime is None else runtime
+        self.evaluation = (
+            {"cv_mode": "full_build"} if evaluation is None else evaluation
+        )
+        self.metadata = _as_metadata({} if metadata is None else metadata)
+        self.host = f"gordoserver-{project_name}-{name}"
 
     @classmethod
     def from_config(
@@ -80,51 +106,29 @@ class Machine:
         project_name: str = "project",
         config_globals: Optional[dict] = None,
     ) -> "Machine":
-        """Build a Machine from one YAML config block plus the `globals` block."""
-        if config_globals is None:
-            config_globals = dict()
-
-        name = config["name"]
-        model = config.get("model") or config_globals.get("model")
-
-        local_runtime = config.get("runtime", dict())
-        runtime = patch_dict(config_globals.get("runtime", dict()), local_runtime)
-
-        dataset_config = patch_dict(
-            config.get("dataset", dict()), config_globals.get("dataset", dict())
-        )
-        dataset = GordoBaseDataset.from_dict(dataset_config)
-        evaluation = patch_dict(
-            config_globals.get("evaluation", dict()), config.get("evaluation", dict())
-        )
-
-        metadata = Metadata(
-            user_defined={
-                "global-metadata": config_globals.get("metadata", dict()),
-                "machine-metadata": config.get("metadata", dict()),
-            }
-        )
+        """Build a Machine from one YAML block merged with ``globals``."""
+        g = config_globals or {}
+        user_metadata = {
+            "global-metadata": g.get("metadata") or {},
+            "machine-metadata": config.get("metadata") or {},
+        }
         return cls(
-            name,
-            model,
-            dataset,
-            metadata=metadata,
-            runtime=runtime,
+            name=config["name"],
+            model=config.get("model") or g.get("model"),
+            dataset=_merged_section("dataset", config, g),
             project_name=project_name,
-            evaluation=evaluation,
+            evaluation=_merged_section("evaluation", config, g),
+            metadata=Metadata(user_defined=user_metadata),
+            runtime=_merged_section("runtime", config, g),
         )
-
-    def __str__(self):
-        return yaml.dump(self.to_dict())
-
-    def __eq__(self, other):
-        return self.to_dict() == other.to_dict()
 
     @classmethod
     def from_dict(cls, d: dict) -> "Machine":
+        """Inverse of :meth:`to_dict`."""
         return cls(**d)
 
     def to_dict(self) -> dict:
+        """Primitive-dict form; feeds ``from_dict`` and the pod env JSON."""
         return {
             "name": self.name,
             "dataset": self.dataset.to_dict(),
@@ -137,7 +141,8 @@ class Machine:
 
     def report(self):
         """
-        Run any reporters declared in the machine's runtime, e.g.::
+        Dispatch this machine to every reporter declared under
+        ``runtime.reporters``, e.g.::
 
             runtime:
               reporters:
@@ -146,19 +151,31 @@ class Machine:
         """
         from gordo_tpu.reporters.base import BaseReporter
 
-        for reporter in map(BaseReporter.from_dict, self.runtime.get("reporters", [])):
+        for spec in self.runtime.get("reporters", []):
+            reporter = BaseReporter.from_dict(spec)
             logger.debug("Using reporter: %s", reporter)
             reporter.report(self)
+
+    def __eq__(self, other):
+        return self.to_dict() == other.to_dict()
+
+    def __str__(self):
+        return yaml.dump(self.to_dict())
+
+
+# (predicate, converter) pairs tried in order by MachineEncoder.
+_JSON_FALLBACKS = (
+    (lambda o: isinstance(o, datetime), lambda o: o.isoformat()),
+    (lambda o: np.issubdtype(type(o), np.floating), float),
+    (lambda o: np.issubdtype(type(o), np.integer), int),
+)
 
 
 class MachineEncoder(json.JSONEncoder):
     """JSON encoder tolerating datetimes and numpy scalars."""
 
     def default(self, obj):
-        if isinstance(obj, datetime):
-            return obj.isoformat()
-        elif np.issubdtype(type(obj), np.floating):
-            return float(obj)
-        elif np.issubdtype(type(obj), np.integer):
-            return int(obj)
-        return json.JSONEncoder.default(self, obj)
+        for accepts, convert in _JSON_FALLBACKS:
+            if accepts(obj):
+                return convert(obj)
+        return super().default(obj)

@@ -1,13 +1,16 @@
 """
 Native (C++) data-layer kernels, bound via ctypes.
 
-The shared library is compiled on demand with g++ from the source shipped in
-this package (no pybind11 in the image; plain ``extern "C"`` + ctypes). The
-build artifact is cached under ``$GORDO_TPU_NATIVE_CACHE`` (default
-``~/.cache/gordo_tpu``) keyed by a source hash, so a source change triggers
-exactly one rebuild. Everything degrades gracefully: if g++ is missing, the
-build fails, or ``$GORDO_TPU_NO_NATIVE`` is set, ``available()`` returns
-False and callers use their pure-numpy/pandas fallbacks.
+The shared library is compiled with g++ from the source shipped in this
+package (no pybind11 in the image; plain ``extern "C"`` + ctypes). The build
+artifact is cached under ``$GORDO_TPU_NATIVE_CACHE`` (default
+``~/.cache/gordo_tpu``) keyed by source hash + compiler identity + flags, so
+a source change or toolchain upgrade triggers exactly one rebuild. Builds
+are asynchronous by default — ``available()`` never blocks; call
+``prebuild(block=True)`` at process startup (the CLI does) to guarantee the
+native path. Everything degrades gracefully: if g++ is missing, the build
+fails, or ``$GORDO_TPU_NO_NATIVE`` is set, ``available()`` returns False and
+callers use their pure-numpy/pandas fallbacks.
 
 Reference context: the reference's data layer is the gordo-dataset pip
 package (pandas resample/join per tag, SURVEY.md L0); there is no native
@@ -38,9 +41,13 @@ AGG_CODES = {
     "median": 5,
 }
 
+_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_builder_thread: Optional[threading.Thread] = None
+_so_path_cache: Optional[str] = None
 
 
 def _cache_dir() -> str:
@@ -50,26 +57,41 @@ def _cache_dir() -> str:
     )
 
 
+def _compiler_id() -> bytes:
+    """g++ identity for the cache key; a toolchain change must miss the cache."""
+    try:
+        proc = subprocess.run(
+            ["g++", "--version"], capture_output=True, timeout=10
+        )
+        return proc.stdout.splitlines()[0] if proc.stdout else b"unknown"
+    except (OSError, subprocess.TimeoutExpired, IndexError):
+        return b"unknown"
+
+
+def _so_path() -> str:
+    """Cache-key path; computed once per process (the g++ subprocess and
+    source hash must not run per available() call on the data hot path)."""
+    global _so_path_cache
+    if _so_path_cache is None:
+        with open(_SRC, "rb") as fh:
+            src = fh.read()
+        key = src + b"\0" + _compiler_id() + b"\0" + " ".join(_FLAGS).encode()
+        digest = hashlib.sha256(key).hexdigest()[:16]
+        _so_path_cache = os.path.join(
+            _cache_dir(), f"gordo_native-{digest}.so"
+        )
+    return _so_path_cache
+
+
 def _build() -> Optional[str]:
-    with open(_SRC, "rb") as fh:
-        src = fh.read()
-    digest = hashlib.sha256(src).hexdigest()[:16]
-    out_dir = _cache_dir()
-    so_path = os.path.join(out_dir, f"gordo_native-{digest}.so")
+    so_path = _so_path()
     if os.path.exists(so_path):
         return so_path
-    os.makedirs(out_dir, exist_ok=True)
+    os.makedirs(os.path.dirname(so_path), exist_ok=True)
+    # pid suffix: concurrent builds in other processes get distinct tmp
+    # files (in-process, only the single builder thread calls _build)
     tmp_path = so_path + f".tmp.{os.getpid()}"
-    cmd = [
-        "g++",
-        "-O3",
-        "-std=c++17",
-        "-shared",
-        "-fPIC",
-        _SRC,
-        "-o",
-        tmp_path,
-    ]
+    cmd = ["g++", *_FLAGS, _SRC, "-o", tmp_path]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as exc:
@@ -82,11 +104,55 @@ def _build() -> Optional[str]:
             proc.stderr.decode(errors="replace")[:2000],
         )
         return None
-    os.replace(tmp_path, so_path)  # atomic: concurrent builders race safely
+    os.replace(tmp_path, so_path)  # atomic also vs cross-process racers
     return so_path
 
 
+def _builder_main() -> None:
+    """Daemon-thread body: one build attempt; a failure latches _load_failed
+    so callers stop stat-ing the cache and stay on the pandas path."""
+    global _load_failed
+    if _build() is None:
+        _load_failed = True
+
+
+def _ensure_builder_thread() -> threading.Thread:
+    """Start (at most once per process) the background builder thread."""
+    global _builder_thread
+    with _lock:
+        if _builder_thread is None:
+            _builder_thread = threading.Thread(target=_builder_main, daemon=True)
+            _builder_thread.start()
+        return _builder_thread
+
+
+def prebuild(block: bool = True) -> bool:
+    """
+    Compile the native library ahead of use (server/builder startup hook).
+
+    With ``block=False``, kicks off the build in a daemon thread and returns
+    immediately; ``available()`` stays False (callers use their pandas
+    fallbacks) until the artifact lands in the cache. With ``block=True``,
+    joins that same single builder thread — a concurrent background build is
+    never duplicated.
+    """
+    if os.environ.get("GORDO_TPU_NO_NATIVE"):
+        return False
+    thread = _ensure_builder_thread()
+    if block:
+        thread.join(timeout=180)
+    return os.path.exists(_so_path())
+
+
 def _load() -> Optional[ctypes.CDLL]:
+    """
+    Load the cached library; never compiles synchronously.
+
+    A cache miss kicks off one background build (daemon thread) and returns
+    None, so the first dataset build in a fresh process takes the pandas path
+    instead of stalling every thread behind a 120 s compile. Call
+    ``prebuild(block=True)`` at startup to guarantee the native path.
+    """
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
@@ -96,10 +162,13 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("GORDO_TPU_NO_NATIVE"):
             _load_failed = True
             return None
-        so_path = _build()
-        if so_path is None:
-            _load_failed = True
-            return None
+    so_path = _so_path()
+    if not os.path.exists(so_path):
+        _ensure_builder_thread()
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
         try:
             lib = ctypes.CDLL(so_path)
         except OSError as exc:
@@ -129,7 +198,12 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def available() -> bool:
-    """True when the native library is importable (builds it on first call)."""
+    """
+    True when the native library is loaded or cached ready-to-load.
+
+    Never blocks: a cold cache starts one background compile and this
+    returns False until it lands (callers keep their pandas fallbacks).
+    """
     return _load() is not None
 
 

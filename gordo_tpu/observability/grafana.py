@@ -209,9 +209,11 @@ def servers_dashboard() -> Dict[str, Any]:
         ),
         _stat(
             "Error ratio (5m)",
-            "sum(rate(gordo_server_requests_total"
+            # `or vector(0)` keeps the stat at 0 (not NaN from 0/0) when idle
+            "(sum(rate(gordo_server_requests_total"
             f'{{{_SEL},status_code=~"5.."}}[5m])) / '
-            f"sum(rate(gordo_server_requests_total{{{_SEL}}}[5m]))",
+            f"sum(rate(gordo_server_requests_total{{{_SEL}}}[5m]))) "
+            "or vector(0)",
             panel_id=7,
             x=_PANEL_W + 6,
             y=2 * _PANEL_H,

@@ -21,10 +21,18 @@ def into_definition(pipeline, prune_default_params: bool = False) -> dict:
     return _decompose_node(pipeline, prune_default_params)
 
 
+def _has_own_hook(step: object, hook: str) -> bool:
+    """True when ``hook`` is defined on the class itself — instance-level
+    hasattr would also pick up ``__getattr__`` delegation to a wrapped
+    estimator (e.g. an anomaly detector forwarding to base_estimator),
+    flattening the wrapper out of the definition."""
+    return hasattr(type(step), hook)
+
+
 def _decompose_node(step: object, prune_default_params: bool = False) -> dict:
     import_str = f"{step.__module__}.{step.__class__.__name__}"
 
-    if hasattr(step, "into_definition"):
+    if _has_own_hook(step, "into_definition"):
         definition = getattr(step, "into_definition")()
     else:
         params = getattr(step, "get_params")(deep=False)
@@ -52,7 +60,9 @@ def load_definition_from_params(params: dict) -> dict:
     """Recursively decompose each param value into primitives."""
     definition: dict = {}
     for param, param_val in params.items():
-        if hasattr(param_val, "get_params") or hasattr(param_val, "into_definition"):
+        if _has_own_hook(param_val, "get_params") or _has_own_hook(
+            param_val, "into_definition"
+        ):
             definition[param] = _decompose_node(param_val)
         elif isinstance(param_val, list):
             definition[param] = [

@@ -265,49 +265,128 @@ def run_server(
     logger.info(
         "Starting server on %s:%s with %d worker(s)", host, port, workers
     )
-    if workers > 1:
-        # reap dead workers and retire their multiprocess metric files
-        # (reference gunicorn child_exit hook, prometheus/gunicorn_config.py);
-        # installed before forking so a worker dying at startup is still
-        # reaped. Only pids in worker_pids are waited on, so exit statuses
-        # of unrelated subprocesses are never stolen from their owners.
-        import signal
+    if workers == 1:
+        # single worker: serve inline, no arbiter
+        app = build_app()
+        make_server(host, port, app, threaded=True, fd=sock.fileno()).serve_forever()
+        return
 
-        from gordo_tpu.server.prometheus.server import mark_worker_dead
+    # Prefork pool with a pure arbiter parent (the reference's gunicorn
+    # arbiter, server.py:233-297): the parent owns no serving threads, so
+    # forking replacement workers after a death is fork-safe. Dead workers
+    # are reaped (retiring their multiprocess metric files — gunicorn
+    # child_exit hook analog) and respawned, so the pool never shrinks.
+    import signal
+    import time as _time
 
-        worker_pids: set = set()
+    from gordo_tpu.server.prometheus.server import mark_worker_dead
 
-        def _reap(signum, frame):
-            for pid in list(worker_pids):
-                try:
-                    reaped, _status = os.waitpid(pid, os.WNOHANG)
-                except ChildProcessError:
-                    worker_pids.discard(pid)
+    worker_pids: set = set()
+    spawn_times: dict = {}
+    shutting_down = False
+    # A worker dying within FAST_DEATH_S of its spawn counts as a boot
+    # failure; MAX_FAST_DEATHS consecutive ones stop the respawn loop (the
+    # gunicorn arbiter's worker-boot-error throttle) instead of fork-bombing.
+    FAST_DEATH_S = 2.0
+    MAX_FAST_DEATHS = 5
+    fast_deaths = 0
+
+    def _serve_child() -> "None":  # never returns
+        # any escape path must os._exit: an exception unwinding out of the
+        # forked child would execute the arbiter's inherited finally block
+        # (SIGTERM-ing healthy siblings) in the child
+        try:
+            signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            # app built per worker process: model cache and metric values are
+            # process-local (metrics aggregate via the multiprocess dir)
+            app = build_app()
+            make_server(
+                host, port, app, threaded=True, fd=sock.fileno()
+            ).serve_forever()
+        except BaseException:
+            logger.exception("worker failed to boot/serve")
+            os._exit(1)
+        os._exit(0)
+
+    def _spawn() -> None:
+        start = _time.monotonic()
+        pid = os.fork()
+        if pid == 0:
+            _serve_child()
+        # spawn time recorded before the pid becomes reapable via
+        # worker_pids, so _reap never sees a missing entry
+        spawn_times[pid] = start
+        worker_pids.add(pid)
+
+    def _reap(signum, frame):
+        # Only pids in worker_pids are waited on, so exit statuses of
+        # unrelated subprocesses are never stolen from their owners.
+        nonlocal fast_deaths
+        for pid in list(worker_pids):
+            try:
+                reaped, _status = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                worker_pids.discard(pid)
+                continue
+            if reaped == pid:
+                worker_pids.discard(pid)
+                mark_worker_dead(pid)
+                if shutting_down:
                     continue
-                if reaped == pid:
-                    worker_pids.discard(pid)
-                    mark_worker_dead(pid)
+                lifetime = _time.monotonic() - spawn_times.pop(pid, 0.0)
+                if lifetime < FAST_DEATH_S:
+                    fast_deaths += 1
+                else:
+                    fast_deaths = 0
+                if fast_deaths >= MAX_FAST_DEATHS:
+                    logger.error(
+                        "worker %d died after %.1fs; %d consecutive boot "
+                        "failures — not respawning",
+                        pid, lifetime, fast_deaths,
+                    )
+                    continue
+                logger.warning("worker %d died; spawning replacement", pid)
+                _spawn()
 
+    # SIGTERM must run the cleanup below (the default action would kill the
+    # arbiter outright, orphaning the pool), so convert it to SystemExit
+    def _terminate(signum, frame):
+        raise SystemExit(0)
+
+    try:
+        # handlers installed inside the try so a SIGTERM arriving while
+        # workers are being forked still reaches the cleanup block
+        signal.signal(signal.SIGTERM, _terminate)
+        # installed before forking so a worker dying at startup is reaped
         signal.signal(signal.SIGCHLD, _reap)
-
-        in_child = False
-        for _ in range(workers - 1):
-            pid = os.fork()
-            if pid == 0:
-                # child: shed the reaper, serve on the inherited socket
-                signal.signal(signal.SIGCHLD, signal.SIG_DFL)
-                in_child = True
-                break
-            worker_pids.add(pid)
-        if not in_child:
-            # catch any worker that died before its pid entered worker_pids
-            # (SIGCHLD delivered mid-loop finds an incomplete set)
-            _reap(None, None)
-
-    # app built per worker process: model cache and metric values are
-    # process-local (metrics aggregate via the multiprocess dir)
-    app = build_app()
-    server = make_server(
-        host, port, app, threaded=True, fd=sock.fileno()
-    )
-    server.serve_forever()
+        for _ in range(workers):
+            _spawn()
+        # catch any worker that died before its pid entered worker_pids
+        # (SIGCHLD delivered mid-loop finds an incomplete set)
+        _reap(None, None)
+        while True:
+            # poll-sleep instead of signal.pause(): the terminal condition
+            # can be reached by handlers that ran before pause() would
+            # block, after which no further SIGCHLD ever arrives
+            if fast_deaths >= MAX_FAST_DEATHS and not worker_pids:
+                raise RuntimeError(
+                    "all workers failed at boot; see logs for the child error"
+                )
+            _time.sleep(1)
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        shutting_down = True
+        # a second SIGTERM must not abort the cleanup midway
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        for pid in list(worker_pids):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in list(worker_pids):
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass

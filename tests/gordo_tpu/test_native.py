@@ -14,7 +14,9 @@ from gordo_tpu import native
 from gordo_tpu.dataset.datasets import TimeSeriesDataset
 
 pytestmark = pytest.mark.skipif(
-    not native.available(), reason="native library unavailable (no g++?)"
+    # available() is async on a cold cache; force the build for the suite
+    not (native.prebuild(block=True) and native.available()),
+    reason="native library unavailable (no g++?)",
 )
 
 

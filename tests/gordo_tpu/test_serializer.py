@@ -74,6 +74,31 @@ def test_into_definition_roundtrip():
     assert pipe2.steps[1][1].kwargs["epochs"] == 2
 
 
+def test_into_definition_anomaly_detector_roundtrip():
+    """Regression: DiffBasedAnomalyDetector.__getattr__ delegates unknown
+    attributes to base_estimator; into_definition must not pick up the base
+    estimator's into_definition hook through that delegation (it used to
+    flatten the wrapper, producing a definition that can't be re-loaded)."""
+    definition = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 1,
+                }
+            }
+        }
+    }
+    obj = serializer.from_definition(definition)
+    d2 = serializer.into_definition(obj)
+    key = "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector"
+    assert "base_estimator" in d2[key], d2
+    # and the definition reconstructs — the full CLI round-trip
+    obj2 = serializer.from_definition(d2)
+    assert type(obj2).__name__ == "DiffBasedAnomalyDetector"
+    assert obj2.base_estimator.kwargs["epochs"] == 1
+
+
 def test_function_transformer_roundtrip():
     definition = yaml.safe_load(
         """
