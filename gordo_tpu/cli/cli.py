@@ -222,20 +222,47 @@ def build(
     default=False,
     help="Fail instead of falling back to serial builds for unbatchable models",
 )
+@click.option(
+    "--coordinator-address",
+    default=None,
+    envvar="GORDO_TPU_COORDINATOR_ADDRESS",
+    help="host:port of process 0 for multi-host training "
+    "(jax.distributed); omit for single-host",
+)
+@click.option(
+    "--num-processes",
+    type=int,
+    default=None,
+    envvar="GORDO_TPU_NUM_PROCESSES",
+    help="Total number of hosts in the multi-host world",
+)
+@click.option(
+    "--process-id",
+    type=int,
+    default=None,
+    envvar="GORDO_TPU_PROCESS_ID",
+    help="This host's rank in the multi-host world",
+)
 def batch_build(
     config_file: str,
     output_dir: str,
     project_name: str,
     machines: str,
     no_serial_fallback: bool,
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
 ):
     """
-    Train EVERY machine in a config in one process on the device mesh
-    (the TPU-native replacement for per-machine worker pods).
+    Train EVERY machine in a config in one SPMD program on the device mesh
+    (the TPU-native replacement for per-machine worker pods). With
+    --coordinator-address/--num-processes/--process-id the mesh spans hosts
+    and each host trains + saves its shard of the fleet.
     """
-    from gordo_tpu.parallel import BatchedModelBuilder
+    from gordo_tpu.parallel import BatchedModelBuilder, distributed
     from gordo_tpu.workflow.normalized_config import NormalizedConfig
 
+    distributed.initialize(coordinator_address, num_processes, process_id)
     native.prebuild(block=True)
     with open(config_file) as f:
         config = yaml.safe_load(f)

@@ -126,6 +126,14 @@ def workflow_cli():
     envvar=f"{PREFIX}_TPU_CHIPS_PER_WORKER",
 )
 @click.option(
+    "--tpu-workers-per-slice",
+    type=int,
+    default=1,
+    envvar=f"{PREFIX}_TPU_WORKERS_PER_SLICE",
+    help="Hosts per TPU slice; >1 turns on multi-host training "
+    "(jax.distributed auto-detection on the slice)",
+)
+@click.option(
     "--server-replicas",
     type=int,
     default=2,
@@ -303,6 +311,7 @@ def generate_workflow_docs(
     tpu_accelerator_type: str = "tpu-v5-lite-podslice",
     tpu_topology: str = "2x4",
     tpu_chips_per_worker: int = 8,
+    tpu_workers_per_slice: int = 1,
     server_replicas: int = 2,
     server_workers: int = 2,
     ml_server_hpa_type: str = "cpu",
@@ -422,6 +431,7 @@ def generate_workflow_docs(
                 "accelerator_type": tpu_accelerator_type,
                 "topology": tpu_topology,
                 "chips_per_worker": tpu_chips_per_worker,
+                "num_workers": tpu_workers_per_slice,
                 "jax_platforms": "tpu",
             },
             "builder_resources": norm.globals["runtime"]["builder"][

@@ -11,6 +11,7 @@ XLA program with zero inter-machine communication (embarrassingly-parallel
 SPMD; collectives only appear in the multi-host data path).
 """
 
+from . import distributed
 from .mesh import default_mesh, machines_sharding
 from .batch_trainer import BatchedModelBuilder
 from .ring_attention import make_ring_attention, sequence_sharding

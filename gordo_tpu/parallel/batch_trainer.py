@@ -236,11 +236,16 @@ def _bucket_program(
     batch_size: int,
     shuffle: bool,
     scale_x: bool,
+    out_sharding=None,
 ):
     """
     Compile the full per-machine build for one bucket:
     per-fold (scale → init → train → predict-test), then final fit.
     Returns a function of stacked (X, y, seeds) suitable for vmap.
+
+    ``out_sharding``: force every output's machine axis onto this sharding.
+    Required in multi-process mode, where each host reads back only its
+    addressable rows — XLA must not replicate outputs.
     """
     n_full = n_train_samples(spec, n_rows)
     fit_full = make_scanned_fit(spec, n_full, batch_size, epochs, shuffle)
@@ -272,6 +277,8 @@ def _bucket_program(
         return p_final, losses, tuple(fold_preds)
 
     batched = jax.vmap(one_machine)
+    if out_sharding is not None:
+        return jax.jit(batched, out_shardings=out_sharding)
     return jax.jit(batched)
 
 
@@ -355,6 +362,18 @@ class BatchedModelBuilder:
 
     # ------------------------------------------------------------- build
     def build(self) -> List[Tuple[Any, Machine]]:
+        """
+        Train and return ``(model, machine)`` per machine.
+
+        Single-process: results cover every machine, input order. In a
+        multi-process world (``parallel.distributed``), each process returns
+        only the machines whose mesh rows are on its local devices plus its
+        round-robin share of serial-fallback machines — together the
+        processes cover the fleet exactly once, and each host persists its
+        own share (the SPMD replacement for one-pod-per-machine fan-out).
+        """
+        from gordo_tpu.parallel import distributed
+
         results: Dict[int, Tuple[Any, Machine]] = {}
         plans: Dict[int, _Plan] = {}
         serial: List[int] = []
@@ -366,12 +385,14 @@ class BatchedModelBuilder:
             else:
                 plans[i] = plan
 
-        for i in serial:
+        for ordinal, i in enumerate(serial):
             if not self.serial_fallback:
                 raise ValueError(
                     f"Machine {self.machines[i].name} is not batchable and "
                     f"serial_fallback=False"
                 )
+            if not distributed.owns_serial_machine(ordinal):
+                continue
             logger.info("Machine %s: serial fallback", self.machines[i].name)
             results[i] = ModelBuilder(self.machines[i]).build()
 
@@ -389,10 +410,10 @@ class BatchedModelBuilder:
 
         for key, idxs in buckets.items():
             bucket_plans = [plans[i] for i in idxs]
-            for i, built in zip(idxs, self._build_bucket(bucket_plans)):
+            for i, built in self._build_bucket(bucket_plans, idxs):
                 results[i] = built
 
-        return [results[i] for i in range(len(self.machines))]
+        return [results[i] for i in sorted(results)]
 
     def _fold_bounds(self, n_rows: int, n_splits: int) -> Tuple[Tuple[int, int, int], ...]:
         splitter = TimeSeriesSplit(n_splits=n_splits)
@@ -401,7 +422,9 @@ class BatchedModelBuilder:
             bounds.append((int(train_idx[-1]) + 1, int(test_idx[0]), int(test_idx[-1]) + 1))
         return tuple(bounds)
 
-    def _build_bucket(self, bucket: List[_Plan]) -> List[Tuple[Any, Machine]]:
+    def _build_bucket(
+        self, bucket: List[_Plan], global_idxs: List[int]
+    ) -> List[Tuple[int, Tuple[Any, Machine]]]:
         plan0 = bucket[0]
         spec = plan0.spec
         n_rows = len(plan0.X)
@@ -424,6 +447,10 @@ class BatchedModelBuilder:
         # reused for every chunk, so compile cost doesn't scale with M
         chunk = ((min(self.chunk_size, M) + n_dev - 1) // n_dev) * n_dev
 
+        from gordo_tpu.parallel import distributed
+
+        multiprocess = distributed.is_multiprocess()
+        sharding = machines_sharding(self.mesh)
         program = _bucket_program(
             spec,
             n_rows,
@@ -432,8 +459,8 @@ class BatchedModelBuilder:
             plan0.batch_size,
             plan0.shuffle,
             plan0.scale_x,
+            out_sharding=sharding if multiprocess else None,
         )
-        sharding = machines_sharding(self.mesh)
 
         t0 = time.time()
 
@@ -450,19 +477,32 @@ class BatchedModelBuilder:
                 [_machine_seed(p.machine) for p in group] + [0] * pad,
                 dtype=np.uint32,
             )
-            X_d = jax.device_put(X, sharding)
-            y_d = jax.device_put(y, sharding)
-            seeds_d = jax.device_put(seeds, sharding)
+            X_d = distributed.make_global_stacked(sharding, X)
+            y_d = distributed.make_global_stacked(sharding, y)
+            seeds_d = distributed.make_global_stacked(sharding, seeds)
             return group, program(X_d, y_d, seeds_d)
 
         def fetch(group, outputs):
             params_stack, losses, fold_preds = outputs
-            return (
-                group,
-                jax.device_get(params_stack),
-                np.asarray(jax.device_get(losses)),
-                [np.asarray(jax.device_get(fp)) for fp in fold_preds],
+            if not multiprocess:
+                # one batched host transfer for the whole tree
+                losses_np = np.asarray(jax.device_get(losses))
+                return (
+                    group,
+                    np.arange(losses_np.shape[0]),
+                    jax.device_get(params_stack),
+                    losses_np,
+                    [np.asarray(jax.device_get(fp)) for fp in fold_preds],
+                )
+            # multi-process: only this host's rows are addressable; every
+            # output shares the machines sharding, so the rows from `losses`
+            # apply to all leaves
+            rows, losses_np = distributed.local_rows(losses)
+            params_np = jax.tree_util.tree_map(
+                lambda a: distributed.local_rows(a)[1], params_stack
             )
+            fold_preds_np = [distributed.local_rows(fp)[1] for fp in fold_preds]
+            return group, rows, params_np, losses_np, fold_preds_np
 
         # keep at most 2 chunks in flight: dispatch chunk k+1 (async) before
         # fetching chunk k, so transfers overlap compute while peak HBM stays
@@ -481,7 +521,7 @@ class BatchedModelBuilder:
             M, chunk, train_duration,
         )
 
-        # ---- host-side assembly per machine
+        # ---- host-side assembly per machine (this process's rows only)
         out = []
         # the fused program interleaves CV-fold training with the final fit;
         # apportion its wall time by fold count for the two metadata fields
@@ -489,21 +529,25 @@ class BatchedModelBuilder:
         per_machine = train_duration / M
         cv_share = per_machine * len(fold_bounds) / n_stages
         fit_share = per_machine / n_stages
-        for group, params_stack, losses, fold_preds in chunk_results:
-            for i, plan in enumerate(group):
-                params_i = jax.tree_util.tree_map(lambda a: a[i], params_stack)
-                fold_preds_i = [fp[i] for fp in fold_preds]
-                out.append(
-                    self._assemble(
-                        plan,
-                        params_i,
-                        losses[i],
-                        fold_preds_i,
-                        fold_bounds,
-                        fit_share,
-                        cv_share,
-                    )
+        offset = 0  # running chunk start within the bucket
+        for group, rows, params_stack, losses, fold_preds in chunk_results:
+            for j, row in enumerate(int(r) for r in rows):
+                if row >= len(group):
+                    continue  # padding rows replicate group[0]; skip
+                plan = group[row]
+                params_i = jax.tree_util.tree_map(lambda a: a[j], params_stack)
+                fold_preds_i = [fp[j] for fp in fold_preds]
+                built = self._assemble(
+                    plan,
+                    params_i,
+                    losses[j],
+                    fold_preds_i,
+                    fold_bounds,
+                    fit_share,
+                    cv_share,
                 )
+                out.append((global_idxs[offset + row], built))
+            offset += len(group)
         return out
 
     # --------------------------------------------------------- assembly

@@ -4,8 +4,10 @@ Device-mesh construction and shardings.
 The canonical mesh for multi-model training is 1-D over all chips with axis
 ``machines``; stacked per-machine arrays (params, data, rngs) shard along
 that axis so each chip trains its shard of machines with no collectives.
-Multi-host: the same Mesh spans hosts via jax.distributed — XLA handles
-ICI/DCN placement.
+Multi-host: after ``parallel.distributed.initialize()`` the same Mesh spans
+every host's chips (``jax.devices()`` is global), each host materializes
+only its addressable shards, and XLA handles ICI/DCN placement — see
+``parallel/distributed.py`` and ``tests/gordo_tpu/test_distributed.py``.
 """
 
 from typing import Optional, Sequence

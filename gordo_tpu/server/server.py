@@ -342,7 +342,7 @@ def run_server(
                 if fast_deaths >= MAX_FAST_DEATHS:
                     logger.error(
                         "worker %d died after %.1fs; %d consecutive boot "
-                        "failures — not respawning",
+                        "failures — throttling respawn",
                         pid, lifetime, fast_deaths,
                     )
                     continue
@@ -365,6 +365,8 @@ def run_server(
         # catch any worker that died before its pid entered worker_pids
         # (SIGCHLD delivered mid-loop finds an incomplete set)
         _reap(None, None)
+        RETRY_S = 10.0
+        last_retry = _time.monotonic()
         while True:
             # poll-sleep instead of signal.pause(): the terminal condition
             # can be reached by handlers that ran before pause() would
@@ -373,6 +375,22 @@ def run_server(
                 raise RuntimeError(
                     "all workers failed at boot; see logs for the child error"
                 )
+            # throttled healing: once the fast-death limit trips, lost
+            # slots are retried at most once per RETRY_S (a transient boot
+            # failure must not permanently shrink the pool, but a
+            # persistent one must not fork-bomb)
+            now = _time.monotonic()
+            if (
+                len(worker_pids) < workers
+                and fast_deaths >= MAX_FAST_DEATHS
+                and now - last_retry >= RETRY_S
+            ):
+                last_retry = now
+                logger.warning(
+                    "pool at %d/%d workers; retrying one respawn",
+                    len(worker_pids), workers,
+                )
+                _spawn()
             _time.sleep(1)
     except (KeyboardInterrupt, SystemExit):
         pass
