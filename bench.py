@@ -1,16 +1,23 @@
 """
-Headline benchmark: autoencoder machines/min trained (BASELINE.json metric).
+Headline benchmark (both BASELINE.json metrics in ONE json line):
+autoencoder machines/min trained + server samples/sec and p50 anomaly latency.
 
-Measures the batched multi-machine trainer on the reference's canonical
+Training: the batched multi-machine trainer on the reference's canonical
 workload shape — per-machine hourglass autoencoders over 4 sensor tags,
 7 days of 10-minute data, MinMaxScaler + DiffBased anomaly wrapper with
 3-fold TimeSeriesSplit CV and thresholds (reference tests/conftest.py config).
 
-Baseline: the reference publishes no numbers (BASELINE.md); its architecture
-is one single-threaded Keras build per k8s pod. As the in-repo proxy baseline
-we time our own serial per-machine builder (same work, one machine at a time,
-analogous to one gordo builder pod) and report the batched/serial speedup as
-``vs_baseline``.
+Serving: POST the reference benchmark harness shape (100 samples × 4 tags,
+/root/reference/benchmarks/test_ml_server.py:21-30) to the in-process WSGI
+app's anomaly endpoint.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md) and its
+TF/Keras isn't in this image, so the denominator is a reference-shaped
+single-machine build in torch CPU — same data, same hourglass layer dims,
+Adam+MSE, same epochs/batch, 3 CV fold trainings + final fit — i.e. what one
+reference builder pod does, on the CPU the reference ran on. The repo's own
+warmed serial path (compile-cache hit, one machine at a time) is reported
+alongside in ``detail`` for an apples-to-apples in-framework comparison.
 
 Prints exactly one JSON line.
 """
@@ -56,6 +63,119 @@ def _machine_config(name: str) -> dict:
                 },
             }
         },
+    }
+
+
+def _torch_baseline_sec_per_machine(n_rows: int = 1008, n_tags: int = 4) -> float:
+    """
+    Time one reference-shaped machine build in torch on CPU.
+
+    Mirrors the per-pod work of the reference builder
+    (gordo/builder/build_model.py:169-289): dataset fetch, then 3
+    TimeSeriesSplit fold trainings of a fresh hourglass autoencoder + one
+    final full fit, Adam + MSE, EPOCHS epochs at batch 128, plus fold
+    predictions. Hourglass dims follow the same halving schedule as our
+    ModelSpec (factories/utils.py parity). The data fetch uses our dataset
+    layer (faster than the reference's pandas-only resample — a denominator
+    advantage, keeping the comparison conservative).
+    """
+    import numpy as np
+    import torch
+    from sklearn.model_selection import TimeSeriesSplit
+
+    from gordo_tpu.dataset import GordoBaseDataset
+    from gordo_tpu.models.factories.utils import hourglass_calc_dims
+
+    torch.set_num_threads(max(1, os.cpu_count() or 1))
+    dims = hourglass_calc_dims(0.5, 3, n_tags)
+    dataset_cfg = _machine_config("torch-baseline")["dataset"]
+
+    def make_model():
+        # full mirror incl. the doubled bottleneck, matching
+        # feedforward_hourglass's [*dims, *dims[::-1], n_out] schedule
+        sizes = [n_tags, *dims, *dims[::-1], n_tags]
+        layers = []
+        for a, b in zip(sizes, sizes[1:]):
+            layers += [torch.nn.Linear(a, b), torch.nn.Tanh()]
+        return torch.nn.Sequential(*layers[:-1])
+
+    t_start = time.time()
+    X_df, _ = GordoBaseDataset.from_dict(dict(dataset_cfg)).get_data()
+    X = torch.tensor(X_df.to_numpy(np.float32)[:n_rows])
+    n_rows = len(X)
+
+    def fit(n):
+        model = make_model()
+        opt = torch.optim.Adam(model.parameters())
+        loss_fn = torch.nn.MSELoss()
+        data = X[:n]
+        for _ in range(EPOCHS):
+            for s in range(0, n, 128):
+                batch = data[s : s + 128]
+                opt.zero_grad()
+                loss = loss_fn(model(batch), batch)
+                loss.backward()
+                opt.step()
+        return model
+
+    for train_idx, test_idx in TimeSeriesSplit(n_splits=3).split(X):
+        model = fit(len(train_idx))
+        with torch.no_grad():
+            model(X[test_idx])
+    fit(n_rows)
+    return time.time() - t_start
+
+
+def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
+    """
+    BASELINE metric #2: server samples/sec + p50 anomaly latency.
+
+    Serves one of the just-trained models through the real WSGI app and
+    POSTs the reference harness shape (100 samples × n_tags JSON to
+    /anomaly/prediction, reference benchmarks/test_ml_server.py:21-30).
+    """
+    import statistics
+    import tempfile
+    import timeit
+
+    import numpy as np
+
+    from gordo_tpu import serializer
+    from gordo_tpu.server.server import build_app
+
+    if rounds is None:
+        rounds = int(os.environ.get("BENCH_SERVER_ROUNDS", "100"))
+
+    model, machine_out = built
+    collection = os.path.join(tempfile.mkdtemp(prefix="bench-srv-"), "rev-1")
+    model_dir = os.path.join(collection, machine_out.name)
+    os.makedirs(model_dir)
+    serializer.dump(model, model_dir, metadata=machine_out.to_dict())
+
+    app = build_app({"MODEL_COLLECTION_DIR": collection})
+    client = app.test_client()
+    n_tags = len(machine_out.dataset.tag_list)
+    rng = np.random.RandomState(0)
+    X = rng.random_sample((samples, n_tags)).tolist()
+    body = json.dumps({"X": X, "y": X}).encode()
+    path = f"/gordo/v0/bench/{machine_out.name}/anomaly/prediction"
+
+    resp = client.post(path, data=body, content_type="application/json")
+    assert resp.status_code == 200, (resp.status_code, resp.text[:500])
+    times = []
+    for _ in range(rounds):
+        start = timeit.default_timer()
+        resp = client.post(path, data=body, content_type="application/json")
+        times.append(timeit.default_timer() - start)
+        assert resp.status_code == 200
+    times.sort()
+    mean = statistics.fmean(times)
+    return {
+        "rounds": rounds,
+        "samples_per_post": samples,
+        "p50_ms": round(times[len(times) // 2] * 1e3, 3),
+        "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 3),
+        "samples_per_sec": round(samples / mean, 1),
     }
 
 
@@ -125,25 +245,50 @@ def main():
     assert len(results) == N_MACHINES
     machines_per_min = N_MACHINES / batched_sec * 60.0
 
-    # ---- serial proxy baseline (one machine at a time, gordo-pod style)
+    # ---- in-framework serial path (one machine at a time, gordo-pod style).
+    # Warm the compile cache first: the serial number should measure the
+    # steady-state per-machine cost, not one-time XLA compilation (which the
+    # batched path already pays exactly once for the whole fleet).
+    ModelBuilder(machines[0]).build()
+    serial_targets = machines[1 : 1 + N_SERIAL] or machines[:1]
     t0 = time.time()
-    for machine in machines[:N_SERIAL]:
+    for machine in serial_targets:
         ModelBuilder(machine).build()
-    serial_sec_per_machine = (time.time() - t0) / N_SERIAL
+    serial_sec_per_machine = (time.time() - t0) / len(serial_targets)
     serial_machines_per_min = 60.0 / serial_sec_per_machine
+
+    # ---- reference-shaped baseline: one builder-pod's work in torch CPU
+    _torch_baseline_sec_per_machine()  # warmup (thread pools, allocator)
+    torch_sec_per_machine = _torch_baseline_sec_per_machine()
+    torch_machines_per_min = 60.0 / torch_sec_per_machine
+
+    # ---- serving: reference harness shape on the anomaly endpoint
+    serving = _bench_serving(results[0])
 
     print(
         json.dumps(
             {
                 "metric": "autoencoder machines/min trained (4-tag hourglass AE, "
-                "3-fold CV + thresholds, 1008 rows)",
+                "3-fold CV + thresholds, 1008 rows); server anomaly POST "
+                "(100 samples x 4 tags)",
                 "value": round(machines_per_min, 2),
                 "unit": "machines/min",
-                "vs_baseline": round(machines_per_min / serial_machines_per_min, 2),
+                "vs_baseline": round(
+                    machines_per_min / torch_machines_per_min, 2
+                ),
+                "server_samples_per_sec": serving["samples_per_sec"],
+                "server_p50_anomaly_ms": serving["p50_ms"],
                 "detail": {
                     "n_machines": N_MACHINES,
                     "batched_wall_sec": round(batched_sec, 2),
                     "serial_machines_per_min": round(serial_machines_per_min, 2),
+                    "torch_baseline_machines_per_min": round(
+                        torch_machines_per_min, 2
+                    ),
+                    "vs_own_serial": round(
+                        machines_per_min / serial_machines_per_min, 2
+                    ),
+                    "serving": serving,
                     "platform": jax.devices()[0].platform,
                     "n_devices": len(jax.devices()),
                 },

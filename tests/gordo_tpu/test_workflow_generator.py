@@ -252,3 +252,45 @@ def test_chunk_machines():
     assert chunk_machines([], 3) == []
     with pytest.raises(ValueError):
         chunk_machines([1], 0)
+
+
+def test_multihost_slice_rendering():
+    """--tpu-workers-per-slice > 1 must render per-chunk coordinator
+    Services and one rank-parameterized builder pod per slice host."""
+    docs = generate_workflow_docs(
+        _config_yaml(4), project_name="mh-proj", tpu_workers_per_slice=2
+    )
+    parsed = [d for d in yaml.safe_load_all(docs) if d]
+    templates = {t["name"]: t for d in parsed for t in d["spec"]["templates"]}
+    assert "gordo-coordinator-service" in templates
+    svc = yaml.safe_load(
+        templates["gordo-coordinator-service"]["resource"]["manifest"]
+    )
+    assert svc["spec"]["clusterIP"] == "None"  # k8s headless literal
+    assert svc["spec"]["selector"]["gordo-tpu/worker"] == "0"
+
+    builder = templates["tpu-batch-builder"]
+    env = {
+        e["name"]: e.get("value")
+        for e in builder["container"]["env"]
+    }
+    assert env["GORDO_TPU_NUM_PROCESSES"] == "2"
+    assert env["GORDO_TPU_PROCESS_ID"] == "{{inputs.parameters.worker-id}}"
+    assert "gordo-coord-mh-proj-" in env["GORDO_TPU_COORDINATOR_ADDRESS"]
+
+    dag = templates["do-all"]["dag"]["tasks"]
+    builders = [t for t in dag if t["template"] == "tpu-batch-builder"]
+    assert builders and all("withSequence" in t for t in builders)
+    assert all(
+        t["withSequence"]["count"] == "2" for t in builders
+    )
+    coords = [t for t in dag if t["template"] == "gordo-coordinator-service"]
+    assert len(coords) == len(builders)
+
+
+def test_singlehost_has_no_coordinator():
+    docs = generate_workflow_docs(_config_yaml(2), project_name="sh-proj")
+    parsed = [d for d in yaml.safe_load_all(docs) if d]
+    names = [t["name"] for d in parsed for t in d["spec"]["templates"]]
+    assert "gordo-coordinator-service" not in names
+    assert "withSequence" not in docs
