@@ -23,15 +23,23 @@ import tempfile
 import timeit
 
 
-def _build_collection(n_tags: int) -> str:
-    """Train one small model via local_build and dump it server-style."""
+def _build_collection(n_tags: int, n_models: int = 1) -> str:
+    """Train model(s) via local_build and dump them server-style. With
+    ``n_models`` == 1 the single model is named ``bench-machine`` (the
+    latency bench); otherwise ``bench-machine-{i}`` (the concurrency A/B)."""
     from gordo_tpu import serializer
     from gordo_tpu.builder.local_build import local_build
 
     tags = "".join(f"\n        - bench-tag-{i}" for i in range(n_tags))
-    config = f"""
-machines:
-  - name: bench-machine
+    names = (
+        ["bench-machine"]
+        if n_models == 1
+        else [f"bench-machine-{i}" for i in range(n_models)]
+    )
+    blocks = []
+    for name in names:
+        blocks.append(f"""
+  - name: {name}
     dataset:
       tags:{tags}
       target_tag_list:{tags}
@@ -49,15 +57,15 @@ machines:
               - sklearn.preprocessing.MinMaxScaler
               - gordo_tpu.models.models.AutoEncoder:
                   kind: feedforward_hourglass
-                  epochs: 3
-"""
+                  epochs: 3""")
+    config = "machines:" + "".join(blocks) + "\n"
     collection = os.path.join(
         tempfile.mkdtemp(prefix="bench-collection-"), "rev-bench"
     )
-    model_dir = os.path.join(collection, "bench-machine")
-    os.makedirs(model_dir)
-    ((model, machine),) = local_build(config)
-    serializer.dump(model, model_dir, metadata=machine.to_dict())
+    for model, machine in local_build(config):
+        model_dir = os.path.join(collection, machine.name)
+        os.makedirs(model_dir)
+        serializer.dump(model, model_dir, metadata=machine.to_dict())
     return collection
 
 
@@ -140,12 +148,109 @@ def run(rounds: int, samples: int, n_tags: int) -> int:
     return failures
 
 
+def run_concurrent(
+    rounds: int, samples: int, n_tags: int, users: int, n_models: int
+) -> int:
+    """
+    Cross-model batching A/B: ``users`` threads POST anomaly requests round-
+    robin over ``n_models`` same-architecture models, with the cross-model
+    batcher off then on. Prints one JSON line per mode; the batched mode
+    should show higher samples/sec once concurrency exceeds ~2 (the
+    reference's answer to serving concurrency is more gunicorn processes —
+    here one process + one fused device call does the work).
+    """
+    import threading
+    import timeit
+
+    import numpy as np
+
+    from gordo_tpu.server import batcher as batcher_mod
+    from gordo_tpu.server.server import build_app
+
+    collection = _build_collection(n_tags, n_models=n_models)
+    app = build_app({"MODEL_COLLECTION_DIR": collection})
+    client = app.test_client()
+
+    rng = np.random.RandomState(0)
+    X = rng.random_sample((samples, n_tags)).tolist()
+    body = json.dumps({"X": X, "y": X}).encode()
+    paths = [
+        f"/gordo/v0/bench/bench-machine-{i}/anomaly/prediction"
+        for i in range(n_models)
+    ]
+
+    def drive(mode_on: bool) -> dict:
+        os.environ["GORDO_TPU_SERVING_BATCH"] = "1" if mode_on else "0"
+        batcher_mod._batcher = None
+        # warmup every model (jit + lru model cache)
+        for path in paths:
+            resp = client.post(path, data=body, content_type="application/json")
+            assert resp.status_code == 200, (path, resp.status_code)
+
+        times: list = []
+        lock = threading.Lock()
+
+        def worker(k: int):
+            for r in range(rounds):
+                path = paths[(k + r) % n_models]
+                start = timeit.default_timer()
+                resp = client.post(
+                    path, data=body, content_type="application/json"
+                )
+                elapsed = timeit.default_timer() - start
+                assert resp.status_code == 200
+                with lock:
+                    times.append(elapsed)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(users)
+        ]
+        wall0 = timeit.default_timer()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = timeit.default_timer() - wall0
+        times.sort()
+        stats = batcher_mod._batcher.stats if batcher_mod._batcher else {}
+        return {
+            "mode": "batched" if mode_on else "direct",
+            "users": users,
+            "n_models": n_models,
+            "requests": len(times),
+            "samples_per_sec": round(len(times) * samples / wall, 1),
+            "p50_ms": round(times[len(times) // 2] * 1e3, 3),
+            "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 3),
+            "batcher_stats": dict(stats),
+        }
+
+    direct = drive(False)
+    batched = drive(True)
+    for row in (direct, batched):
+        print(json.dumps(row))
+    speedup = batched["samples_per_sec"] / max(direct["samples_per_sec"], 1e-9)
+    print(json.dumps({"batching_speedup": round(speedup, 2)}))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rounds", type=int, default=100)
     parser.add_argument("--samples", type=int, default=100)
     parser.add_argument("--tags", type=int, default=4)
+    parser.add_argument(
+        "--concurrency",
+        type=int,
+        default=0,
+        help="If >0: run the cross-model batching A/B with this many "
+        "client threads",
+    )
+    parser.add_argument("--models", type=int, default=8)
     args = parser.parse_args(argv)
+    if args.concurrency > 0:
+        return run_concurrent(
+            args.rounds, args.samples, args.tags, args.concurrency, args.models
+        )
     return run(args.rounds, args.samples, args.tags)
 
 

@@ -302,10 +302,19 @@ def batch_build(
     default=50,
     envvar="GORDO_SERVER_WORKER_CONNECTIONS",
 )
-def run_server_cli(host, port, workers, worker_connections):
+@click.option(
+    "--batch-predicts/--no-batch-predicts",
+    default=True,
+    envvar="GORDO_TPU_SERVING_BATCH",
+    help="Fuse concurrent same-architecture predicts into one device call",
+)
+def run_server_cli(host, port, workers, worker_connections, batch_predicts):
     """Run the gordo-tpu model server."""
     from gordo_tpu.server import run_server
 
+    # the switch must be in env before workers fork; each worker process
+    # then builds its own batcher on first use
+    os.environ["GORDO_TPU_SERVING_BATCH"] = "1" if batch_predicts else "0"
     run_server(host, port, workers, worker_connections=worker_connections)
 
 

@@ -189,6 +189,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         if not hasattr(self, "params_"):
             raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
         X = self._as_2d_array(X)
+        # serving: concurrent predicts across models fuse into one device
+        # call when the cross-model batcher is enabled (server/batcher.py)
+        from gordo_tpu.server.batcher import maybe_submit
+
+        batched = maybe_submit(self.spec_, self.params_, X)
+        if batched is not None:
+            return batched
         return train_ops.predict_fn(self.spec_)(self.params_, X)
 
     def transform(self, X) -> np.ndarray:

@@ -350,29 +350,39 @@ def _build_predictor(spec: ModelSpec):
         return jax.jit(run)
 
     def predict(params, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, np.float32)
-        n_out = n_train_samples(spec, len(X))
-        if n_out <= 0:
-            raise ValueError(
-                f"Need at least {spec.lookback_window + spec.lookahead} rows, got {len(X)}"
-            )
-        if spec.lookback_window <= 1 and spec.lookahead == 0:
-            n_pad = _next_pow2(len(X))
-            X_pad = np.zeros((n_pad, X.shape[1]), np.float32)
-            X_pad[: len(X)] = X
-            out = padded_apply(n_pad)(params, jnp.asarray(X_pad))
-            return np.asarray(out[: len(X)])
-        else:
-            n_pad = _next_pow2(n_out)
-            # pad the flat series so every window start up to n_pad is valid;
-            # targets index up to n_pad-1 + lookback-1 + lookahead. Must also
-            # hold all of X itself.
-            rows_needed = max(
-                n_pad + spec.lookback_window - 1 + spec.lookahead, len(X)
-            )
-            X_pad = np.zeros((rows_needed, X.shape[1]), np.float32)
-            X_pad[: len(X)] = X
-            out = padded_apply(n_pad)(params, jnp.asarray(X_pad))
-            return np.asarray(out[:n_out])
+        X_pad, n_pad, n_keep = pad_for_predict(spec, X)
+        out = padded_apply(n_pad)(params, jnp.asarray(X_pad))
+        return np.asarray(out[:n_keep])
 
     return predict
+
+
+def pad_for_predict(spec: ModelSpec, X) -> Tuple[np.ndarray, int, int]:
+    """
+    Power-of-two padding for a serving-time predict.
+
+    Returns ``(X_pad, n_pad, n_keep)``: the padded input, the bucketed
+    output length the compiled program produces, and how many leading output
+    rows are real. Shared between the per-request predictor
+    (:func:`predict_fn`) and the cross-model batcher
+    (server/batcher.py), so both hit the same compiled-shape buckets.
+    """
+    X = np.asarray(X, np.float32)
+    n_out = n_train_samples(spec, len(X))
+    if n_out <= 0:
+        raise ValueError(
+            f"Need at least {spec.lookback_window + spec.lookahead} rows, got {len(X)}"
+        )
+    if spec.lookback_window <= 1 and spec.lookahead == 0:
+        n_pad = _next_pow2(len(X))
+        X_pad = np.zeros((n_pad, X.shape[1]), np.float32)
+        X_pad[: len(X)] = X
+        return X_pad, n_pad, len(X)
+    n_pad = _next_pow2(n_out)
+    # pad the flat series so every window start up to n_pad is valid;
+    # targets index up to n_pad-1 + lookback-1 + lookahead. Must also
+    # hold all of X itself.
+    rows_needed = max(n_pad + spec.lookback_window - 1 + spec.lookahead, len(X))
+    X_pad = np.zeros((rows_needed, X.shape[1]), np.float32)
+    X_pad[: len(X)] = X
+    return X_pad, n_pad, n_out

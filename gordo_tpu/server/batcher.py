@@ -1,0 +1,212 @@
+"""
+Cross-model request batcher: many models' predicts, one device call.
+
+The reference scales serving by adding gunicorn processes behind an HPA
+(gordo/server/server.py:233-297) — each request runs its own Keras forward
+pass. On an accelerator that leaves the matrix units idle: one 100×4
+autoencoder forward is far below the chip's saturation point. This batcher
+is the serving-side twin of the BatchedModelBuilder: concurrent predicts
+whose models share a ModelSpec (and padded input shape) are stacked on a
+leading axis and executed as ONE vmapped, jitted program; results fan back
+out to the waiting request threads.
+
+Correctness: vmap evaluates each (params, X) pair independently — outputs
+are identical to per-request predicts (asserted by tests/test_batcher.py).
+Shape discipline: inputs are pre-padded with the same power-of-two buckets
+as the per-request path (ops/train.py pad_for_predict) and the batch axis
+is padded to powers of two, so the compiled-program set stays bounded.
+
+Enabled in server processes via $GORDO_TPU_SERVING_BATCH=1 (run-server sets
+it with --batch-predicts); BaseJaxEstimator.predict routes through
+``maybe_submit`` which no-ops to the direct path when disabled.
+"""
+
+import functools
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Item:
+    spec: Any
+    params: Any
+    X_pad: np.ndarray
+    n_pad: int
+    n_keep: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[np.ndarray] = None
+    error: Optional[BaseException] = None
+
+
+@functools.lru_cache(maxsize=256)
+def _stacked_apply(spec, n_pad: int, batch: int):
+    """One compiled program per (spec, padded length, batch bucket)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_tpu.ops.nn import apply_model
+
+    if spec.lookback_window <= 1 and spec.lookahead == 0:
+
+        def one(params, X):
+            out, _ = apply_model(spec, params, X)
+            return out
+
+    else:
+
+        def one(params, X):
+            idx = jnp.arange(n_pad)
+            window = jnp.arange(spec.lookback_window)
+            xb = X[idx[:, None] + window[None, :]]
+            out, _ = apply_model(spec, params, xb)
+            return out
+
+    return jax.jit(jax.vmap(one))
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class CrossModelBatcher:
+    """Collects concurrent predict submissions for a short window and runs
+    each same-shape group as one stacked device call."""
+
+    def __init__(self, window_ms: float = 2.0, max_batch: int = 64):
+        self.window_s = window_ms / 1e3
+        self.max_batch = max_batch
+        self._q: "queue.Queue[_Item]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # observability: exposed through /healthcheck-adjacent metrics and
+        # asserted by tests
+        self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
+
+    # ------------------------------------------------------------- public
+    def submit(self, spec, params, X) -> np.ndarray:
+        """Blocking predict through the batch queue (thread-safe)."""
+        from gordo_tpu.ops.train import pad_for_predict
+
+        X_pad, n_pad, n_keep = pad_for_predict(spec, X)
+        item = _Item(spec, params, X_pad, n_pad, n_keep)
+        self._ensure_thread()
+        self._q.put(item)
+        if not item.done.wait(timeout=120):
+            raise TimeoutError("batched predict timed out")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    # ------------------------------------------------------------ worker
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="gordo-batcher"
+                )
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            batch = [self._q.get()]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._run(batch)
+
+    def _run(self, batch: List[_Item]):
+        groups: Dict[Tuple, List[_Item]] = {}
+        for item in batch:
+            key = (item.spec, item.X_pad.shape)
+            groups.setdefault(key, []).append(item)
+        for (spec, _shape), items in groups.items():
+            try:
+                self._run_group(spec, items)
+            except BaseException as exc:  # noqa: BLE001 — fan the error out
+                for item in items:
+                    item.error = exc
+                    item.done.set()
+
+    def _run_group(self, spec, items: List[_Item]):
+        import jax
+        import jax.numpy as jnp
+
+        n = len(items)
+        b_pad = _next_pow2(n)
+        X = np.stack(
+            [it.X_pad for it in items]
+            + [items[0].X_pad] * (b_pad - n)
+        )
+        params = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves),
+            *([it.params for it in items] + [items[0].params] * (b_pad - n)),
+        )
+        out = _stacked_apply(spec, items[0].n_pad, b_pad)(params, X)
+        out = np.asarray(out)
+        self.stats["items"] += n
+        self.stats["device_calls"] += 1
+        self.stats["largest_batch"] = max(self.stats["largest_batch"], n)
+        for i, item in enumerate(items):
+            item.result = out[i, : item.n_keep]
+            item.done.set()
+
+
+# ------------------------------------------------------------ global switch
+_batcher: Optional[CrossModelBatcher] = None
+_batcher_lock = threading.Lock()
+
+
+def get_batcher() -> Optional[CrossModelBatcher]:
+    """The process batcher, created on first use when enabled by env."""
+    global _batcher
+    if _batcher is not None:
+        return _batcher
+    if os.environ.get("GORDO_TPU_SERVING_BATCH", "").lower() not in (
+        "1", "true", "yes",
+    ):
+        return None
+    with _batcher_lock:
+        if _batcher is None:
+            window_ms = float(os.environ.get("GORDO_TPU_BATCH_WINDOW_MS", "2"))
+            max_batch = int(os.environ.get("GORDO_TPU_BATCH_MAX", "64"))
+            _batcher = CrossModelBatcher(window_ms, max_batch)
+            logger.info(
+                "cross-model batcher on (window %.1fms, max %d)",
+                window_ms, max_batch,
+            )
+    return _batcher
+
+
+def maybe_submit(spec, params, X) -> Optional[np.ndarray]:
+    """Route through the batcher when enabled; None means 'go direct'.
+
+    The dispatcher thread itself must not re-enter the queue (a model whose
+    predict is invoked inside another predict would deadlock), so it always
+    goes direct.
+    """
+    batcher = get_batcher()
+    if batcher is None:
+        return None
+    if threading.current_thread().name == "gordo-batcher":
+        return None
+    return batcher.submit(spec, params, X)

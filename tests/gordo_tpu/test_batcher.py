@@ -1,0 +1,144 @@
+"""
+Cross-model serving batcher: correctness against the direct path, grouping,
+and end-to-end through the WSGI app under concurrent load.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from gordo_tpu.models.models import AutoEncoder
+from gordo_tpu.server import batcher as batcher_mod
+from gordo_tpu.server.batcher import CrossModelBatcher
+
+
+def _fitted_autoencoder(seed: int, n_features: int = 4) -> AutoEncoder:
+    rng = np.random.RandomState(seed)
+    est = AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    X = rng.rand(64, n_features)
+    est.fit(X, X)
+    return est
+
+
+@pytest.fixture(scope="module")
+def models():
+    return [_fitted_autoencoder(seed) for seed in range(3)]
+
+
+def test_batched_matches_direct(models):
+    b = CrossModelBatcher(window_ms=10, max_batch=8)
+    rng = np.random.RandomState(0)
+    X = rng.rand(50, 4).astype(np.float32)
+
+    direct = [m.predict(X) for m in models]
+
+    results = [None] * len(models)
+
+    def run(i):
+        results[i] = b.submit(models[i].spec_, models[i].params_, X)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(models))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    for got, want in zip(results, direct):
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    assert b.stats["items"] == len(models)
+    # at least two predicts fused into one device call
+    assert b.stats["device_calls"] < len(models)
+    assert b.stats["largest_batch"] >= 2
+
+
+def test_mixed_shapes_grouped_separately(models):
+    b = CrossModelBatcher(window_ms=10, max_batch=8)
+    rng = np.random.RandomState(1)
+    X_small = rng.rand(20, 4).astype(np.float32)
+    X_large = rng.rand(200, 4).astype(np.float32)
+
+    outputs = {}
+
+    def run(key, m, X):
+        outputs[key] = b.submit(m.spec_, m.params_, X)
+
+    threads = [
+        threading.Thread(target=run, args=("s0", models[0], X_small)),
+        threading.Thread(target=run, args=("l1", models[1], X_large)),
+        threading.Thread(target=run, args=("s2", models[2], X_small)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    np.testing.assert_allclose(
+        outputs["s0"], models[0].predict(X_small), rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        outputs["l1"], models[1].predict(X_large), rtol=1e-6, atol=1e-7
+    )
+    assert outputs["s0"].shape == (20, 4)
+    assert outputs["l1"].shape == (200, 4)
+
+
+def test_error_fans_out_to_waiters(models):
+    b = CrossModelBatcher(window_ms=5, max_batch=8)
+    bad_params = "not-a-pytree-of-arrays"
+    with pytest.raises(Exception):
+        b.submit(models[0].spec_, bad_params, np.random.rand(10, 4))
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_SERVING_BATCH", raising=False)
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    assert batcher_mod.get_batcher() is None
+    assert batcher_mod.maybe_submit(None, None, None) is None
+
+
+def test_server_end_to_end_with_batching(
+    monkeypatch,
+    model_collection_directory,
+    trained_model_directories,
+    gordo_project,
+    gordo_name,
+):
+    """Concurrent anomaly POSTs through the WSGI app with batching enabled
+    produce the same payloads as with batching disabled."""
+    from gordo_tpu.server.server import build_app
+
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    client = app.test_client()
+    rng = np.random.RandomState(0)
+    X = rng.rand(40, 4).tolist()
+    body = json.dumps({"X": X, "y": X}).encode()
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction"
+
+    def post():
+        return client.post(path, data=body, content_type="application/json")
+
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    monkeypatch.delenv("GORDO_TPU_SERVING_BATCH", raising=False)
+    baseline = post()
+    assert baseline.status_code == 200
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    responses = [None] * 4
+    threads = [
+        threading.Thread(
+            target=lambda i=i: responses.__setitem__(i, post())
+        )
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for resp in responses:
+        assert resp.status_code == 200
+        # 'time-seconds' is wall time; the payload proper must be identical
+        assert json.loads(resp.data)["data"] == json.loads(baseline.data)["data"]
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
