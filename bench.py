@@ -126,6 +126,222 @@ def _torch_baseline_sec_per_machine(n_rows: int = 1008, n_tags: int = 4) -> floa
     return time.time() - t_start
 
 
+# ---------------------------------------------------------------- windowed
+# BASELINE.md items 2/3/5: the shapes where the MXU actually matters —
+# seq-scan LSTMs over lookback-144 windows and a Transformer encoder.
+N_WINDOWED = int(os.environ.get("BENCH_WINDOWED_MACHINES", "64"))
+WINDOWED_EPOCHS = int(os.environ.get("BENCH_WINDOWED_EPOCHS", "2"))
+WINDOWED_TAGS = 8
+LOOKBACK = 144
+
+_WINDOWED_FAMILIES = {
+    "lstm_ae_144": (
+        "gordo_tpu.models.models.LSTMAutoEncoder",
+        {"kind": "lstm_symmetric", "dims": [64, 32]},
+    ),
+    "lstm_forecast_144": (
+        "gordo_tpu.models.models.LSTMForecast",
+        {"kind": "lstm_symmetric", "dims": [64, 32]},
+    ),
+    "transformer_144": (
+        "gordo_tpu.models.models.TransformerAutoEncoder",
+        {"kind": "transformer_model"},
+    ),
+}
+
+
+def _windowed_machine_config(name: str, family: str) -> dict:
+    cls, kind_kwargs = _WINDOWED_FAMILIES[family]
+    return {
+        "name": name,
+        "dataset": {
+            "type": "RandomDataset",
+            "tags": [f"{name}-tag-{j}" for j in range(WINDOWED_TAGS)],
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-08T00:00:00+00:00",
+        },
+        "model": {
+            "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+                "require_thresholds": True,
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                cls: {
+                                    **kind_kwargs,
+                                    "lookback_window": LOOKBACK,
+                                    "epochs": WINDOWED_EPOCHS,
+                                    "batch_size": 64,
+                                }
+                            },
+                        ]
+                    }
+                },
+            }
+        },
+    }
+
+
+def _torch_windowed_sec_per_machine(family: str, n_rows: int = 1008) -> float:
+    """
+    One reference-shaped windowed machine build in torch CPU: 3 fold
+    trainings + final fit + fold predictions, same epochs/batch/window as the
+    batched fleet. LSTM mirror: stacked torch LSTMs (64, 32, 32, 64) with the
+    last step's output through a Linear head — the lstm_symmetric dims=[64,32]
+    schedule. Transformer mirror: Linear→d64 + sinusoidal positions + 2
+    norm-first encoder blocks (4 heads, ff 128, causal mask) + last-step
+    Linear head — the transformer_model defaults.
+    """
+    import math
+
+    import numpy as np
+    import torch
+    from sklearn.model_selection import TimeSeriesSplit
+
+    from gordo_tpu.dataset import GordoBaseDataset
+
+    torch.set_num_threads(max(1, os.cpu_count() or 1))
+    torch.manual_seed(0)
+    D = WINDOWED_TAGS
+    lookahead = 1 if family == "lstm_forecast_144" else 0
+
+    if family.startswith("lstm"):
+
+        class Mirror(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                dims = [64, 32, 32, 64]
+                ins = [D] + dims[:-1]
+                self.cells = torch.nn.ModuleList(
+                    torch.nn.LSTM(i, o, batch_first=True) for i, o in zip(ins, dims)
+                )
+                self.head = torch.nn.Linear(dims[-1], D)
+
+            def forward(self, x):
+                for cell in self.cells:
+                    x, _ = cell(x)
+                return self.head(x[:, -1, :])
+
+    else:
+
+        class Mirror(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                d_model, heads, ff, blocks = 64, 4, 128, 2
+                self.proj = torch.nn.Linear(D, d_model)
+                pos = torch.zeros(LOOKBACK, d_model)
+                t = torch.arange(LOOKBACK, dtype=torch.float32)[:, None]
+                div = torch.exp(
+                    torch.arange(0, d_model, 2, dtype=torch.float32)
+                    * (-math.log(10000.0) / d_model)
+                )
+                pos[:, 0::2] = torch.sin(t * div)
+                pos[:, 1::2] = torch.cos(t * div)
+                self.register_buffer("pos", pos)
+                layer = torch.nn.TransformerEncoderLayer(
+                    d_model, heads, ff, batch_first=True, norm_first=True
+                )
+                self.enc = torch.nn.TransformerEncoder(layer, blocks)
+                self.mask = torch.nn.Transformer.generate_square_subsequent_mask(
+                    LOOKBACK
+                )
+                self.head = torch.nn.Linear(d_model, D)
+
+            def forward(self, x):
+                h = self.proj(x) + self.pos
+                h = self.enc(h, mask=self.mask)
+                return self.head(h[:, -1, :])
+
+    dataset_cfg = _windowed_machine_config(f"torch-{family}", family)["dataset"]
+
+    t_start = time.time()
+    X_df, _ = GordoBaseDataset.from_dict(dict(dataset_cfg)).get_data()
+    series = torch.tensor(X_df.to_numpy(np.float32)[:n_rows])
+    n_rows = len(series)
+
+    def windows(n):
+        n_out = n - LOOKBACK + 1 - lookahead
+        xs = series[:n].unfold(0, LOOKBACK, 1)[:n_out].transpose(1, 2)
+        ys = series[LOOKBACK - 1 + lookahead : LOOKBACK - 1 + lookahead + n_out]
+        return xs, ys
+
+    def fit(n):
+        model = Mirror()
+        opt = torch.optim.Adam(model.parameters())
+        loss_fn = torch.nn.MSELoss()
+        xs, ys = windows(n)
+        for _ in range(WINDOWED_EPOCHS):
+            for s in range(0, len(xs), 64):
+                opt.zero_grad()
+                loss = loss_fn(model(xs[s : s + 64]), ys[s : s + 64])
+                loss.backward()
+                opt.step()
+        return model
+
+    for train_idx, test_idx in TimeSeriesSplit(n_splits=3).split(series):
+        model = fit(len(train_idx))
+        with torch.no_grad():
+            xs_te, _ = windows(len(test_idx))
+            model(xs_te)
+    fit(n_rows)
+    return time.time() - t_start
+
+
+def _bench_windowed() -> dict:
+    """Batched machines/min + torch-CPU denominator per windowed family."""
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import BatchedModelBuilder
+
+    out = {}
+    for family in _WINDOWED_FAMILIES:
+        machines = [
+            Machine.from_config(
+                _windowed_machine_config(f"{family}-{i:03d}", family),
+                project_name="bench",
+            )
+            for i in range(N_WINDOWED)
+        ]
+        t0 = time.time()
+        results = BatchedModelBuilder(machines, serial_fallback=False).build()
+        wall = time.time() - t0
+        assert len(results) == N_WINDOWED
+        torch_sec = _torch_windowed_sec_per_machine(family)
+        out[family] = {
+            "n_machines": N_WINDOWED,
+            "lookback": LOOKBACK,
+            "n_tags": WINDOWED_TAGS,
+            "epochs": WINDOWED_EPOCHS,
+            "batched_wall_sec": round(wall, 2),
+            "machines_per_min": round(N_WINDOWED / wall * 60.0, 2),
+            "torch_sec_per_machine": round(torch_sec, 2),
+            "torch_machines_per_min": round(60.0 / torch_sec, 2),
+            "vs_torch": round((N_WINDOWED / wall) * torch_sec, 2),
+        }
+    return out
+
+
+def _bench_batch_ab() -> dict:
+    """Cross-model serving batcher A/B (round-2 verdict: must be recorded).
+
+    Two shapes: the reference harness hourglass (host-bound — batching is
+    expected ~neutral there) and the LSTM lookback-144 shape where the
+    forward pass does real device work (the regime batching exists for).
+    """
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from bench_server import run_concurrent
+
+    rounds = int(os.environ.get("BENCH_AB_ROUNDS", "15"))
+    return {
+        "hourglass": run_concurrent(
+            rounds, 100, 4, users=16, n_models=8, arch="hourglass", quiet=True
+        ),
+        "lstm_144": run_concurrent(
+            rounds, 432, 4, users=16, n_models=8, arch="lstm", quiet=True
+        ),
+    }
+
+
 def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     """
     BASELINE metric #2: server samples/sec + p50 anomaly latency.
@@ -265,6 +481,16 @@ def main():
     # ---- serving: reference harness shape on the anomaly endpoint
     serving = _bench_serving(results[0])
 
+    # ---- windowed fleets (LSTM/Transformer, lookback 144) + torch CPU
+    windowed = {}
+    if os.environ.get("BENCH_WINDOWED", "1") != "0":
+        windowed = _bench_windowed()
+
+    # ---- cross-model batching A/B (recorded, per round-2 verdict)
+    batch_ab = {}
+    if os.environ.get("BENCH_BATCH_AB", "1") != "0":
+        batch_ab = _bench_batch_ab()
+
     print(
         json.dumps(
             {
@@ -289,6 +515,8 @@ def main():
                         machines_per_min / serial_machines_per_min, 2
                     ),
                     "serving": serving,
+                    "windowed": windowed,
+                    "batch_ab": batch_ab,
                     "platform": jax.devices()[0].platform,
                     "n_devices": len(jax.devices()),
                 },

@@ -23,7 +23,35 @@ import tempfile
 import timeit
 
 
-def _build_collection(n_tags: int, n_models: int = 1) -> str:
+_MODEL_BLOCKS = {
+    "hourglass": """
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        require_thresholds: false
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.models.AutoEncoder:
+                  kind: feedforward_hourglass
+                  epochs: 3""",
+    # a shape where the forward pass does real device work (seq scan over a
+    # 144-step window) — the regime cross-model batching is for
+    "lstm": """
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        require_thresholds: false
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.models.LSTMAutoEncoder:
+                  kind: lstm_symmetric
+                  dims: [64, 32]
+                  lookback_window: 144
+                  epochs: 1""",
+}
+
+
+def _build_collection(n_tags: int, n_models: int = 1, arch: str = "hourglass") -> str:
     """Train model(s) via local_build and dump them server-style. With
     ``n_models`` == 1 the single model is named ``bench-machine`` (the
     latency bench); otherwise ``bench-machine-{i}`` (the concurrency A/B)."""
@@ -48,16 +76,7 @@ def _build_collection(n_tags: int, n_models: int = 1) -> str:
       asset: bench
       data_provider:
         type: RandomDataProvider
-    model:
-      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
-        require_thresholds: false
-        base_estimator:
-          sklearn.pipeline.Pipeline:
-            steps:
-              - sklearn.preprocessing.MinMaxScaler
-              - gordo_tpu.models.models.AutoEncoder:
-                  kind: feedforward_hourglass
-                  epochs: 3""")
+    model:{_MODEL_BLOCKS[arch]}""")
     config = "machines:" + "".join(blocks) + "\n"
     collection = os.path.join(
         tempfile.mkdtemp(prefix="bench-collection-"), "rev-bench"
@@ -149,8 +168,14 @@ def run(rounds: int, samples: int, n_tags: int) -> int:
 
 
 def run_concurrent(
-    rounds: int, samples: int, n_tags: int, users: int, n_models: int
-) -> int:
+    rounds: int,
+    samples: int,
+    n_tags: int,
+    users: int,
+    n_models: int,
+    arch: str = "hourglass",
+    quiet: bool = False,
+) -> dict:
     """
     Cross-model batching A/B: ``users`` threads POST anomaly requests round-
     robin over ``n_models`` same-architecture models, with the cross-model
@@ -167,7 +192,7 @@ def run_concurrent(
     from gordo_tpu.server import batcher as batcher_mod
     from gordo_tpu.server.server import build_app
 
-    collection = _build_collection(n_tags, n_models=n_models)
+    collection = _build_collection(n_tags, n_models=n_models, arch=arch)
     app = build_app({"MODEL_COLLECTION_DIR": collection})
     client = app.test_client()
 
@@ -182,10 +207,29 @@ def run_concurrent(
     def drive(mode_on: bool) -> dict:
         os.environ["GORDO_TPU_SERVING_BATCH"] = "1" if mode_on else "0"
         batcher_mod._batcher = None
-        # warmup every model (jit + lru model cache)
+        # warmup every model (jit + lru model cache), then a concurrent burst
+        # so the batched mode's stacked program is compiled before timing —
+        # a real server warms the same way on its first busy window
         for path in paths:
             resp = client.post(path, data=body, content_type="application/json")
             assert resp.status_code == 200, (path, resp.status_code)
+        warm_threads = [
+            threading.Thread(
+                target=lambda p=p: client.post(
+                    p, data=body, content_type="application/json"
+                )
+            )
+            for p in paths * 2
+        ]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join()
+        if batcher_mod._batcher is not None:
+            # stats should describe only the measured window, not warmup
+            batcher_mod._batcher.stats.update(
+                {"items": 0, "device_calls": 0, "largest_batch": 0}
+            )
 
         times: list = []
         lock = threading.Lock()
@@ -215,6 +259,7 @@ def run_concurrent(
         stats = batcher_mod._batcher.stats if batcher_mod._batcher else {}
         return {
             "mode": "batched" if mode_on else "direct",
+            "arch": arch,
             "users": users,
             "n_models": n_models,
             "requests": len(times),
@@ -226,11 +271,17 @@ def run_concurrent(
 
     direct = drive(False)
     batched = drive(True)
-    for row in (direct, batched):
-        print(json.dumps(row))
     speedup = batched["samples_per_sec"] / max(direct["samples_per_sec"], 1e-9)
-    print(json.dumps({"batching_speedup": round(speedup, 2)}))
-    return 0
+    result = {
+        "direct": direct,
+        "batched": batched,
+        "batching_speedup": round(speedup, 2),
+    }
+    if not quiet:
+        for row in (direct, batched):
+            print(json.dumps(row))
+        print(json.dumps({"batching_speedup": result["batching_speedup"]}))
+    return result
 
 
 def main(argv=None) -> int:
@@ -246,11 +297,20 @@ def main(argv=None) -> int:
         "client threads",
     )
     parser.add_argument("--models", type=int, default=8)
+    parser.add_argument(
+        "--arch", choices=sorted(_MODEL_BLOCKS), default="hourglass"
+    )
     args = parser.parse_args(argv)
     if args.concurrency > 0:
-        return run_concurrent(
-            args.rounds, args.samples, args.tags, args.concurrency, args.models
+        run_concurrent(
+            args.rounds,
+            args.samples,
+            args.tags,
+            args.concurrency,
+            args.models,
+            arch=args.arch,
         )
+        return 0
     return run(args.rounds, args.samples, args.tags)
 
 

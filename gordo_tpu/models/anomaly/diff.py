@@ -31,6 +31,13 @@ from gordo_tpu.models.base import GordoBase
 from gordo_tpu.models.models import AutoEncoder
 
 
+def _rolling_floor_peak(metric, window: int):
+    """Max over the fold of the rolling minimum: a spike-tolerant ceiling for
+    'normal' error. Returns a scalar for a Series metric, a per-column Series
+    for a DataFrame metric."""
+    return metric.rolling(window).min().max()
+
+
 class DiffBasedAnomalyDetector(AnomalyDetectorBase):
     """
     Anomaly detection by diffing model output against the target, with
@@ -152,69 +159,71 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         **kwargs,
     ):
         """
-        TimeSeriesSplit CV; updates threshold attributes from fold statistics
-        (reference diff.py:184-276).
-        """
-        if cv is None:
-            cv = TimeSeriesSplit(n_splits=3)
-        kwargs.update(dict(return_estimator=True, cv=cv))
+        TimeSeriesSplit CV; updates threshold attributes from fold statistics.
 
+        Threshold rule (numerically identical to the reference's,
+        gordo/machine/model/anomaly/diff.py:184-276, which is a recorded
+        metadata contract): for each validation fold take the rolling(w).min()
+        of the error series — a floor that ignores isolated spikes — and use
+        its maximum over the fold as the threshold, at w=6 and, when smoothing
+        is configured, again at w=self.window. The *last* fold (the most
+        recent data under TimeSeriesSplit) supplies the final thresholds.
+        """
+        splitter = cv if cv is not None else TimeSeriesSplit(n_splits=3)
+        kwargs.update(dict(return_estimator=True, cv=splitter))
         cv_output = c_val(self, X=X, y=y, **kwargs)
 
-        self.feature_thresholds_per_fold_ = pd.DataFrame()
-        self.aggregate_thresholds_per_fold_ = {}
-        self.smooth_feature_thresholds_per_fold_ = pd.DataFrame()
-        self.smooth_aggregate_thresholds_per_fold_ = {}
-        smooth_aggregate_threshold_fold = None
-        smooth_tag_thresholds_fold = None
-        tag_thresholds_fold = None
-        aggregate_threshold_fold = None
+        agg_by_fold: dict = {}
+        tag_by_fold: dict = {}
+        smooth_agg_by_fold: dict = {}
+        smooth_tag_by_fold: dict = {}
 
-        for i, ((_, test_idxs), split_model) in enumerate(
-            zip(kwargs["cv"].split(X, y), cv_output["estimator"])
+        fold_models = cv_output["estimator"]
+        for fold, (model, (_, val_idx)) in enumerate(
+            zip(fold_models, splitter.split(X, y))
         ):
-            y_pred = split_model.predict(
-                X.iloc[test_idxs] if isinstance(X, pd.DataFrame) else X[test_idxs]
-            )
-            # adjust for model output offset (windowed models emit fewer rows)
-            test_idxs = test_idxs[-len(y_pred):]
-            y_true = y.iloc[test_idxs] if isinstance(y, pd.DataFrame) else y[test_idxs]
-
-            scaled_mse = self._scaled_mse_per_timestep(split_model, y_true, y_pred)
-            mae = self._absolute_error(y_true, y_pred)
-
-            aggregate_threshold_fold = scaled_mse.rolling(6).min().max()
-            self.aggregate_thresholds_per_fold_[f"fold-{i}"] = aggregate_threshold_fold
-
-            tag_thresholds_fold = mae.rolling(6).min().max()
-            tag_thresholds_fold.name = f"fold-{i}"
-            self.feature_thresholds_per_fold_ = pd.concat(
-                [self.feature_thresholds_per_fold_, tag_thresholds_fold.to_frame().T]
-            )
-
+            label = f"fold-{fold}"
+            point_mse, abs_err = self._validation_errors(model, X, y, val_idx)
+            agg_by_fold[label] = _rolling_floor_peak(point_mse, 6)
+            per_tag = _rolling_floor_peak(abs_err, 6)
+            per_tag.name = label
+            tag_by_fold[label] = per_tag
             if self.window is not None:
-                smooth_aggregate_threshold_fold = (
-                    scaled_mse.rolling(self.window).min().max()
+                smooth_agg_by_fold[label] = _rolling_floor_peak(
+                    point_mse, self.window
                 )
-                self.smooth_aggregate_thresholds_per_fold_[f"fold-{i}"] = (
-                    smooth_aggregate_threshold_fold
-                )
-                smooth_tag_thresholds_fold = mae.rolling(self.window).min().max()
-                smooth_tag_thresholds_fold.name = f"fold-{i}"
-                self.smooth_feature_thresholds_per_fold_ = pd.concat(
-                    [
-                        self.smooth_feature_thresholds_per_fold_,
-                        smooth_tag_thresholds_fold.to_frame().T,
-                    ]
-                )
+                smooth_per_tag = _rolling_floor_peak(abs_err, self.window)
+                smooth_per_tag.name = label
+                smooth_tag_by_fold[label] = smooth_per_tag
 
-        # final thresholds come from the last fold
-        self.feature_thresholds_ = tag_thresholds_fold
-        self.aggregate_threshold_ = aggregate_threshold_fold
-        self.smooth_aggregate_threshold_ = smooth_aggregate_threshold_fold
-        self.smooth_feature_thresholds_ = smooth_tag_thresholds_fold
+        self.aggregate_thresholds_per_fold_ = agg_by_fold
+        self.feature_thresholds_per_fold_ = pd.DataFrame.from_dict(
+            tag_by_fold, orient="index"
+        )
+        self.smooth_aggregate_thresholds_per_fold_ = smooth_agg_by_fold
+        self.smooth_feature_thresholds_per_fold_ = pd.DataFrame.from_dict(
+            smooth_tag_by_fold, orient="index"
+        )
+
+        last = f"fold-{len(fold_models) - 1}" if len(fold_models) else None
+        self.aggregate_threshold_ = agg_by_fold.get(last)
+        self.feature_thresholds_ = tag_by_fold.get(last)
+        self.smooth_aggregate_threshold_ = smooth_agg_by_fold.get(last)
+        self.smooth_feature_thresholds_ = smooth_tag_by_fold.get(last)
 
         return cv_output
+
+    def _validation_errors(self, model, X, y, val_idx):
+        """Scaled per-timestep MSE and per-tag absolute error of one fold
+        model over its validation slice (output-offset aware)."""
+        X_val = X.iloc[val_idx] if isinstance(X, pd.DataFrame) else X[val_idx]
+        pred = model.predict(X_val)
+        kept = val_idx[-len(pred):]  # windowed models emit fewer rows
+        truth = y.iloc[kept] if isinstance(y, pd.DataFrame) else y[kept]
+        return (
+            self._scaled_mse_per_timestep(model, truth, pred),
+            self._absolute_error(truth, pred),
+        )
 
     @staticmethod
     def _scaled_mse_per_timestep(model, y_true, y_pred) -> pd.Series:
@@ -251,96 +260,73 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         anomaly-confidence and total-anomaly-confidence
         (reference diff.py:320-462).
         """
-        model_output = (
+        model_output = np.asarray(
             self.predict(X) if hasattr(self, "predict") else self.transform(X)
         )
+        n = len(model_output)
 
-        data = model_utils.make_base_dataframe(
-            tags=X.columns,
-            model_input=getattr(X, "values", X),
-            model_output=model_output,
-            target_tag_list=y.columns,
-            index=getattr(X, "index", None),
-            frequency=frequency,
+        # everything below is flat numpy on pre-sliced blocks; the frame is
+        # constructed exactly once at the end (the reference — and round 1/2
+        # of this file — built it by repeated MultiIndex joins, which
+        # dominated serve-path latency)
+        model_input = np.asarray(getattr(X, "values", X), dtype=np.float64)[-n:]
+        y_arr = np.asarray(getattr(y, "values", y), dtype=np.float64)[-n:]
+        index = X.index[-n:] if hasattr(X, "index") else pd.RangeIndex(n)
+
+        out_scaled = np.asarray(self.scaler.transform(model_output))
+        y_scaled = np.asarray(self.scaler.transform(y))[-n:]
+        tag_anomaly_scaled = np.abs(out_scaled - y_scaled)
+        total_anomaly_scaled = np.square(tag_anomaly_scaled).mean(axis=1)
+        tag_anomaly_unscaled = np.abs(model_output - y_arr)
+        total_anomaly_unscaled = np.square(tag_anomaly_unscaled).mean(axis=1)
+
+        in_names = [str(c) for c in X.columns]
+        out_names = (
+            [str(c) for c in y.columns]
+            if model_output.shape[1] == len(y.columns)
+            else [str(i) for i in range(model_output.shape[1])]
         )
 
-        model_out_scaled = pd.DataFrame(
-            self.scaler.transform(data["model-output"]),
-            columns=data["model-output"].columns,
-            index=data.index,
-        )
+        tuples = [("start", ""), ("end", "")]
+        blocks = [model_input, model_output]
+        tuples += [("model-input", name) for name in in_names]
+        tuples += [("model-output", name) for name in out_names]
 
-        scaled_y = self.scaler.transform(y)
-        tag_anomaly_scaled = np.abs(model_out_scaled - scaled_y[-len(data):, :])
-        tag_anomaly_scaled.columns = pd.MultiIndex.from_product(
-            (("tag-anomaly-scaled",), tag_anomaly_scaled.columns)
-        )
-        data = data.join(tag_anomaly_scaled)
+        def add_block(top, values, subs=out_names):
+            values = np.asarray(values)
+            if values.ndim == 1:
+                tuples.append((top, ""))
+                blocks.append(values[:, None])
+            else:
+                tuples.extend((top, sub) for sub in subs)
+                blocks.append(values)
 
-        data["total-anomaly-scaled"] = np.square(data["tag-anomaly-scaled"]).mean(axis=1)
-
-        unscaled_abs_diff = pd.DataFrame(
-            data=np.abs(
-                data["model-output"].to_numpy() - np.asarray(y)[-len(data):, :]
-            ),
-            index=data.index,
-            columns=pd.MultiIndex.from_product(
-                (("tag-anomaly-unscaled",), list(y.columns))
-            ),
-        )
-        data = data.join(unscaled_abs_diff)
-
-        data["total-anomaly-unscaled"] = np.square(data["tag-anomaly-unscaled"]).mean(
-            axis=1
-        )
+        add_block("tag-anomaly-scaled", tag_anomaly_scaled)
+        add_block("total-anomaly-scaled", total_anomaly_scaled)
+        add_block("tag-anomaly-unscaled", tag_anomaly_unscaled)
+        add_block("total-anomaly-unscaled", total_anomaly_unscaled)
 
         if self.window is not None and self.smoothing_method is not None:
-            smooth_tag_anomaly_scaled = self._smoothing(tag_anomaly_scaled)
-            smooth_tag_anomaly_scaled.columns = (
-                smooth_tag_anomaly_scaled.columns.set_levels(
-                    ["smooth-tag-anomaly-scaled"], level=0
-                )
-            )
-            data = data.join(smooth_tag_anomaly_scaled)
+            smoothed = {
+                "smooth-tag-anomaly-scaled": tag_anomaly_scaled,
+                "smooth-total-anomaly-scaled": total_anomaly_scaled,
+                "smooth-tag-anomaly-unscaled": tag_anomaly_unscaled,
+                "smooth-total-anomaly-unscaled": total_anomaly_unscaled,
+            }
+            for top, raw in smoothed.items():
+                frame = pd.DataFrame(raw) if raw.ndim > 1 else pd.Series(raw)
+                add_block(top, self._smoothing(frame).to_numpy())
 
-            data["smooth-total-anomaly-scaled"] = self._smoothing(
-                data["total-anomaly-scaled"]
+        if getattr(self, "feature_thresholds_", None) is not None:
+            add_block(
+                "anomaly-confidence",
+                tag_anomaly_unscaled / np.asarray(self.feature_thresholds_),
             )
-
-            smooth_tag_anomaly_unscaled = self._smoothing(unscaled_abs_diff)
-            smooth_tag_anomaly_unscaled.columns = (
-                smooth_tag_anomaly_unscaled.columns.set_levels(
-                    ["smooth-tag-anomaly-unscaled"], level=0
-                )
+        if getattr(self, "aggregate_threshold_", None) is not None:
+            add_block(
+                "total-anomaly-confidence",
+                total_anomaly_scaled / self.aggregate_threshold_,
             )
-            data = data.join(smooth_tag_anomaly_unscaled)
-
-            data["smooth-total-anomaly-unscaled"] = self._smoothing(
-                data["total-anomaly-unscaled"]
-            )
-
-        confidence, index = None, None
-        if hasattr(self, "feature_thresholds_") and self.feature_thresholds_ is not None:
-            confidence = unscaled_abs_diff.values / self.feature_thresholds_.values
-            index = unscaled_abs_diff.index
-
-        if confidence is not None and index is not None:
-            anomaly_confidence_scores = pd.DataFrame(
-                confidence,
-                index=index,
-                columns=pd.MultiIndex.from_product(
-                    (("anomaly-confidence",), data["model-output"].columns)
-                ),
-            )
-            data = data.join(anomaly_confidence_scores)
-
-        total_anomaly_confidence = None
-        if hasattr(self, "aggregate_threshold_") and self.aggregate_threshold_ is not None:
-            total_anomaly_confidence = (
-                data["total-anomaly-scaled"] / self.aggregate_threshold_
-            )
-        if total_anomaly_confidence is not None:
-            data["total-anomaly-confidence"] = total_anomaly_confidence
 
         if self.require_thresholds and not any(
             hasattr(self, attr)
@@ -352,7 +338,9 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
                 f"these thresholds before calling `.anomaly`"
             )
 
-        return data
+        return model_utils.assemble_multiindex_frame(
+            tuples, blocks, index, frequency
+        )
 
 
 class DiffBasedKFCVAnomalyDetector(DiffBasedAnomalyDetector):

@@ -8,7 +8,7 @@ format, so column structure matches exactly.
 """
 
 import functools
-from datetime import datetime, timedelta
+from datetime import timedelta
 from typing import List, Optional, Union
 
 import numpy as np
@@ -51,46 +51,61 @@ def make_base_dataframe(
     model_input = getattr(model_input, "values", model_input)[-len(model_output):, :]
     model_output = getattr(model_output, "values", model_output)
 
-    names_n_values = (("model-input", model_input), ("model-output", model_output))
-
     index = (
-        index[-len(model_output):] if index is not None else range(len(model_output))
+        index[-len(model_output):]
+        if index is not None
+        else pd.RangeIndex(len(model_output))
     )
 
-    start_series = pd.Series(
-        index
-        if isinstance(index, pd.DatetimeIndex)
-        else (None for _ in range(len(index))),
-        index=index,
-    )
-    end_series = start_series.map(
-        lambda start: (start + frequency).isoformat()
-        if isinstance(start, datetime) and frequency is not None
-        else None
-    )
-    start_series = start_series.map(
-        lambda start: start.isoformat() if hasattr(start, "isoformat") else None
-    )
+    start_col, end_col = timestamp_columns(index, frequency)
 
-    columns = pd.MultiIndex.from_product((("start", "end"), ("",)))
-    data: pd.DataFrame = pd.DataFrame(
-        {("start", ""): start_series, ("end", ""): end_series},
-        columns=columns,
-        index=index,
-    )
-
-    for name, values in filter(lambda nv: nv[1] is not None, names_n_values):
+    # assemble once: time columns + a single numeric block, no joins
+    tuples = [("start", ""), ("end", "")]
+    for name, values in (("model-input", model_input), ("model-output", model_output)):
         _tags = tags if name == "model-input" else target_tag_list
         if values.shape[1] == len(_tags):
-            second_lvl_names = map(
-                str, (tag.name if isinstance(tag, SensorTag) else tag for tag in _tags)
-            )
+            subs = [
+                str(tag.name if isinstance(tag, SensorTag) else tag) for tag in _tags
+            ]
         else:
-            second_lvl_names = map(str, range(values.shape[1]))
-        columns = pd.MultiIndex.from_tuples(
-            (name, sub_name) for sub_name in second_lvl_names
-        )
-        other = pd.DataFrame(values[-len(model_output):], columns=columns, index=index)
-        data = data.join(other)
+            subs = [str(i) for i in range(values.shape[1])]
+        tuples.extend((name, sub) for sub in subs)
 
+    return assemble_multiindex_frame(
+        tuples, [model_input, model_output], index, frequency
+    )
+
+
+def assemble_multiindex_frame(
+    tuples, blocks, index, frequency: Optional[timedelta]
+) -> pd.DataFrame:
+    """
+    Construct a server-payload response frame in ONE shot: object-dtype
+    'start'/'end' isoformat columns plus a single hstacked numeric block
+    under MultiIndex ``tuples`` (which must start with the two time columns).
+    Shared by make_base_dataframe and the anomaly-frame assembly so the
+    /prediction and /anomaly payload shapes cannot drift apart.
+    """
+    start_col, end_col = timestamp_columns(index, frequency)
+    time_block = pd.DataFrame(
+        {0: start_col, 1: end_col}, index=index, dtype=object
+    )
+    numeric_block = pd.DataFrame(np.hstack(blocks), index=index)
+    numeric_block.columns = pd.RangeIndex(2, 2 + numeric_block.shape[1])
+    data = pd.concat((time_block, numeric_block), axis=1, copy=False)
+    data.columns = pd.MultiIndex.from_tuples(tuples)
     return data
+
+
+def timestamp_columns(index, frequency: Optional[timedelta]):
+    """('start', 'end') isoformat column values for a response frame."""
+    if isinstance(index, pd.DatetimeIndex):
+        start = [ts.isoformat() for ts in index]
+        if frequency is not None:
+            end = [ts.isoformat() for ts in index + frequency]
+        else:
+            end = [None] * len(index)
+    else:
+        start = [None] * len(index)
+        end = [None] * len(index)
+    return start, end

@@ -189,6 +189,79 @@ def evaluate_loss(spec: ModelSpec, params, X, y) -> float:
     return float(fn(params, jnp.asarray(X), jnp.asarray(y)))
 
 
+def make_masked_epoch_fn(
+    spec: ModelSpec, n_max: int, batch_size: int, shuffle: bool
+) -> Callable:
+    """
+    Like :func:`make_epoch_fn` but the live-sample count is a *traced* value
+    ``n_valid <= n_max``: the index stream is ordered valid-first (shuffled
+    within the valid prefix when ``shuffle``), trailing all-padding batches
+    are optimizer no-ops (params and opt state carried through unchanged, so
+    Adam's moments/step-count see exactly the live steps).
+
+    This is what lets the batched trainer run every CV fold — each a
+    different train-prefix length — through ONE compiled body inside a
+    ``lax.scan`` over folds, instead of unrolling a separately-shaped fit per
+    fold. Compile time of the fleet program drops by ~the fold count; the
+    price is dead trailing steps on short folds, which for the small
+    per-machine models is far below the compile saving.
+    """
+    n_steps = max((n_max + batch_size - 1) // batch_size, 1)
+    n_pad = n_steps * batch_size
+    opt = make_optimizer(spec.optimizer)
+
+    def epoch(params, opt_state, X, y, rng, n_valid):
+        pos = jnp.arange(n_max)
+        if shuffle:
+            # valid-first shuffled order: push invalid keys after every valid
+            keys = jax.random.uniform(rng, (n_max,))
+            order = jnp.argsort(jnp.where(pos < n_valid, keys, keys + 2.0))
+        else:
+            order = pos
+        live = order < n_valid
+        # clamp dead slots to sample 0 (make_epoch_fn's padding convention):
+        # without this, zero-weighted rows past the fold's train prefix would
+        # still leak into the unweighted activity penalty in _loss_terms
+        order = jnp.where(live, order, 0)
+        idx_stream = jnp.concatenate(
+            [order, jnp.zeros((n_pad - n_max,), order.dtype)]
+        )
+        w_stream = jnp.concatenate(
+            [
+                live.astype(jnp.float32),
+                jnp.zeros((n_pad - n_max,), jnp.float32),
+            ]
+        )
+
+        def body(carry, i):
+            params, opt_state, loss_sum, w_sum = carry
+            idx = jax.lax.dynamic_slice(idx_stream, (i * batch_size,), (batch_size,))
+            wb = jax.lax.dynamic_slice(w_stream, (i * batch_size,), (batch_size,))
+            xb, yb = _gather_batch(spec, X, y, idx)
+            loss, grads = jax.value_and_grad(_loss_terms, argnums=1)(
+                spec, params, xb, yb, wb
+            )
+            bw = jnp.sum(wb)
+            live = bw > 0
+            updates, new_opt_state = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            pick = functools.partial(
+                jax.tree_util.tree_map, lambda a, b: jnp.where(live, a, b)
+            )
+            params = pick(new_params, params)
+            opt_state = pick(new_opt_state, opt_state)
+            loss = jnp.where(live, loss, 0.0)
+            return (params, opt_state, loss_sum + loss * bw, w_sum + bw), None
+
+        init = (params, opt_state, jnp.asarray(0.0), jnp.asarray(0.0))
+        (params, opt_state, loss_sum, w_sum), _ = jax.lax.scan(
+            body, init, jnp.arange(n_steps)
+        )
+        return params, opt_state, loss_sum / jnp.maximum(w_sum, 1.0)
+
+    return epoch
+
+
 # ------------------------------------------------- pure scanned fit (vmap)
 def make_scanned_fit(
     spec: ModelSpec,
@@ -352,7 +425,9 @@ def _build_predictor(spec: ModelSpec):
     def predict(params, X: np.ndarray) -> np.ndarray:
         X_pad, n_pad, n_keep = pad_for_predict(spec, X)
         out = padded_apply(n_pad)(params, jnp.asarray(X_pad))
-        return np.asarray(out[:n_keep])
+        # transfer the padded buffer and slice on host: slicing the device
+        # array first would dispatch a second program before the copy
+        return np.asarray(out)[:n_keep]
 
     return predict
 

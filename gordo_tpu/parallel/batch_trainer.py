@@ -53,11 +53,19 @@ from gordo_tpu.machine.metadata import (
     DatasetBuildMetadata,
     ModelBuildMetadata,
 )
-from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+from gordo_tpu.models.anomaly.diff import (
+    DiffBasedAnomalyDetector,
+    DiffBasedKFCVAnomalyDetector,
+)
 from gordo_tpu.models.models import BaseJaxEstimator
 from gordo_tpu.models.spec import ModelSpec
 from gordo_tpu.ops.nn import apply_model, init_model_params
-from gordo_tpu.ops.train import make_scanned_fit, n_train_samples
+from gordo_tpu.ops.train import (
+    make_masked_epoch_fn,
+    make_optimizer,
+    make_scanned_fit,
+    n_train_samples,
+)
 from .mesh import default_mesh, machines_sharding
 
 logger = logging.getLogger(__name__)
@@ -80,6 +88,7 @@ class _Plan:
     spec: ModelSpec
     scale_x: bool
     wrap_anomaly: bool
+    kfcv: bool = False
     anomaly_kwargs: Dict[str, Any] = field(default_factory=dict)
     epochs: int = 1
     batch_size: int = 32
@@ -123,26 +132,48 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         return None
 
     wrap_anomaly = isinstance(model, DiffBasedAnomalyDetector)
+    kfcv = isinstance(model, DiffBasedKFCVAnomalyDetector)
     anomaly_kwargs: Dict[str, Any] = {}
     inner = model
     if wrap_anomaly:
-        if type(model) is not DiffBasedAnomalyDetector:
-            return None  # KFCV variant: serial fallback (KFold shuffled splits)
         anomaly_kwargs = {
             "require_thresholds": model.require_thresholds,
             "window": model.window,
             "smoothing_method": model.smoothing_method,
             "shuffle": model.shuffle,
         }
+        if kfcv:
+            # under the builder the fold geometry comes from evaluation.cv
+            # (TimeSeriesSplit(3) by default) even for the KFCV detector, so
+            # the same contiguous-fold program applies; only the threshold
+            # assembly (percentile of the smoothed validation-error series)
+            # differs. The detector-level pre-fit shuffle is subsumed by the
+            # in-program batch shuffling — an RNG-stream difference, like the
+            # batched path's seeds (module docstring).
+            if type(model) is not DiffBasedKFCVAnomalyDetector:
+                return None
+            anomaly_kwargs["threshold_percentile"] = model.threshold_percentile
+        else:
+            if type(model) is not DiffBasedAnomalyDetector:
+                return None  # unknown subclass: serial fallback
+            if model.shuffle:
+                return None  # pre-shuffled fit: serial fallback
         if not isinstance(model.scaler, MinMaxScaler):
             return None
-        if model.shuffle:
-            return None  # pre-shuffled fit: serial fallback
+        if tuple(getattr(model.scaler, "feature_range", (0, 1))) != (0, 1):
+            # the threshold mirrors scale by raw 1/(max-min); a non-default
+            # feature_range would diverge from the serial scaler's span
+            return None
         inner = model.base_estimator
 
     scale_x = False
     if isinstance(inner, Pipeline):
         if len(inner.steps) == 2 and isinstance(inner.steps[0][1], MinMaxScaler):
+            if tuple(
+                getattr(inner.steps[0][1], "feature_range", (0, 1))
+            ) != (0, 1):
+                # the in-program _minmax hardcodes the default range
+                return None
             scale_x = True
             inner = inner.steps[1][1]
         elif len(inner.steps) == 1:
@@ -185,6 +216,10 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         spec = inner.build_spec(n_features, n_features_out)
     except Exception:
         return None
+    if kfcv and spec.output_offset != 0:
+        # windowed KFCV scatter-fill needs aligned prediction rows; the
+        # serial path has the same restriction (length-mismatched .iloc set)
+        return None
 
     return _Plan(
         machine=machine,
@@ -193,6 +228,7 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         spec=spec,
         scale_x=scale_x,
         wrap_anomaly=wrap_anomaly,
+        kfcv=kfcv,
         anomaly_kwargs=anomaly_kwargs,
         epochs=int(fit_args.get("epochs", 1)),
         batch_size=int(fit_args.get("batch_size", 32)),
@@ -241,12 +277,105 @@ def _bucket_program(
     """
     Compile the full per-machine build for one bucket:
     per-fold (scale → init → train → predict-test), then final fit.
-    Returns a function of stacked (X, y, seeds) suitable for vmap.
+    Returns a function of stacked (X, y, seeds) suitable for vmap, producing
+    ``(final_params, final_losses, fold_preds)`` with fold predictions
+    stacked on a leading fold axis.
+
+    The CV folds and the final fit all run through ONE ``lax.scan`` over
+    "stages" sharing a single mask-padded fit body
+    (ops/train.make_masked_epoch_fn): each stage's live-sample count /
+    scaling-row count / test-slice start are traced scan inputs. XLA
+    therefore compiles one fit, not folds+1 differently-shaped fits —
+    compile time was ~40% of a cold fleet build and scaled with the fold
+    count before this.
 
     ``out_sharding``: force every output's machine axis onto this sharding.
     Required in multi-process mode, where each host reads back only its
     addressable rows — XLA must not replicate outputs.
     """
+    te_lens = {te_end - te_start for _, te_start, te_end in fold_bounds}
+    if len(te_lens) != 1:
+        # non-uniform test slices can't share one predict shape; rare
+        # (TimeSeriesSplit always yields equal test sizes)
+        return _bucket_program_unrolled(
+            spec, n_rows, fold_bounds, epochs, batch_size, shuffle, scale_x,
+            out_sharding,
+        )
+    te_len = te_lens.pop()
+
+    n_full = n_train_samples(spec, n_rows)
+    batch_eff = min(batch_size, max(n_full, 1))
+    epoch_fn = make_masked_epoch_fn(spec, n_full, batch_eff, shuffle)
+    opt = make_optimizer(spec.optimizer)
+    n_folds = len(fold_bounds)
+
+    # per-stage traced inputs: folds first, the full fit last
+    tr_rows = np.array([tr_end for tr_end, _, _ in fold_bounds] + [n_rows])
+    n_valids = np.array(
+        [n_train_samples(spec, tr_end) for tr_end, _, _ in fold_bounds] + [n_full]
+    )
+    te_starts = np.array([te_start for _, te_start, _ in fold_bounds] + [0])
+
+    def one_machine(X, y, seed):
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+
+        def stage(_, inp):
+            k, tr_row, n_valid, te_start = inp
+            k_init, k_fit = jax.random.split(jax.random.fold_in(rng, k))
+            if scale_x:
+                in_train = (jnp.arange(n_rows) < tr_row)[:, None]
+                mn = jnp.min(jnp.where(in_train, X, jnp.inf), axis=0)
+                mx = jnp.max(jnp.where(in_train, X, -jnp.inf), axis=0)
+                span = mx - mn
+                tiny = 10 * jnp.finfo(X.dtype).eps
+                scale = 1.0 / jnp.where(span < tiny, 1.0, span)
+                Xs = (X - mn) * scale
+            else:
+                Xs = X
+            params = init_model_params(k_init, spec)
+            opt_state = opt.init(params)
+
+            def epoch_body(carry, epoch_rng):
+                p, o = carry
+                p, o, loss = epoch_fn(p, o, Xs, y, epoch_rng, n_valid)
+                return (p, o), loss
+
+            (params, _), losses = jax.lax.scan(
+                epoch_body, (params, opt_state), jax.random.split(k_fit, epochs)
+            )
+            Xte = jax.lax.dynamic_slice(Xs, (te_start, 0), (te_len, Xs.shape[1]))
+            pred = _predict_windows(spec, params, Xte)
+            return None, (params, losses, pred)
+
+        stages = (
+            jnp.arange(n_folds + 1),
+            jnp.asarray(tr_rows),
+            jnp.asarray(n_valids),
+            jnp.asarray(te_starts),
+        )
+        _, (params_all, losses_all, preds_all) = jax.lax.scan(stage, None, stages)
+        p_final = jax.tree_util.tree_map(lambda a: a[-1], params_all)
+        # tuple-of-folds output keeps the same contract as the unrolled path
+        return p_final, losses_all[-1], tuple(preds_all[k] for k in range(n_folds))
+
+    batched = jax.vmap(one_machine)
+    if out_sharding is not None:
+        return jax.jit(batched, out_shardings=out_sharding)
+    return jax.jit(batched)
+
+
+def _bucket_program_unrolled(
+    spec: ModelSpec,
+    n_rows: int,
+    fold_bounds: Tuple[Tuple[int, int, int], ...],
+    epochs: int,
+    batch_size: int,
+    shuffle: bool,
+    scale_x: bool,
+    out_sharding=None,
+):
+    """Fallback bucket program with one separately-shaped fit per fold
+    (pre-fused structure); only used when fold test slices are unequal."""
     n_full = n_train_samples(spec, n_rows)
     fit_full = make_scanned_fit(spec, n_full, batch_size, epochs, shuffle)
     fold_fits = [
@@ -583,13 +712,19 @@ class BatchedModelBuilder:
             model = Pipeline([("step_0", mm), ("step_1", est)])
 
         if plan.wrap_anomaly:
-            detector = DiffBasedAnomalyDetector(
+            detector_cls = (
+                DiffBasedKFCVAnomalyDetector if plan.kfcv else DiffBasedAnomalyDetector
+            )
+            detector = detector_cls(
                 base_estimator=model,
                 scaler=MinMaxScaler(),
                 **plan.anomaly_kwargs,
             )
             detector.scaler.fit(y)
-            self._set_thresholds(detector, plan, fold_preds, fold_bounds)
+            if plan.kfcv:
+                self._set_kfcv_thresholds(detector, plan, fold_preds, fold_bounds)
+            else:
+                self._set_thresholds(detector, plan, fold_preds, fold_bounds)
             model = detector
 
         scores = self._fold_scores(plan, fold_preds, fold_bounds)
@@ -710,6 +845,37 @@ class BatchedModelBuilder:
         detector.aggregate_threshold_ = aggregate_threshold_fold
         detector.smooth_aggregate_threshold_ = smooth_agg
         detector.smooth_feature_thresholds_ = smooth_tag
+
+    def _set_kfcv_thresholds(self, detector, plan, fold_preds, fold_bounds):
+        """Percentile thresholds from the in-program fold predictions.
+
+        Serial parity (DiffBasedKFCVAnomalyDetector.cross_validate, reference
+        diff.py:465-645): scatter each fold's validation predictions into
+        full-length series — rows no fold visits stay zero for y_pred and NaN
+        for the mse series, exactly as the serial path initializes them —
+        then smooth with the detector's configured method and take its
+        percentile. The per-fold mse scaling uses the fold model's y-scaler
+        stats, i.e. min/max of that fold's train targets.
+        """
+        y = plan.y
+        y_pred = np.zeros_like(y)
+        val_mse = np.full(len(y), np.nan, dtype=y.dtype)
+        for (tr_end, te_start, te_end), pred in zip(fold_bounds, fold_preds):
+            y_true = y[te_start:te_end]
+            train_y = y[:tr_end]
+            mn = train_y.min(axis=0)
+            rng = train_y.max(axis=0) - mn
+            tiny = 10 * np.finfo(rng.dtype).eps
+            scale = 1.0 / np.where(rng < tiny, 1.0, rng)
+            y_pred[te_start:te_end] = pred
+            val_mse[te_start:te_end] = (((pred - y_true) * scale) ** 2).mean(axis=1)
+
+        detector.aggregate_threshold_ = float(
+            detector._calculate_threshold(pd.Series(val_mse))
+        )
+        detector.feature_thresholds_ = detector._calculate_threshold(
+            pd.DataFrame(np.abs(y - y_pred))
+        )
 
     def _fold_scores(self, plan, fold_preds, fold_bounds) -> Dict[str, Any]:
         """Per-tag + aggregate fold scores, matching the serial builder's

@@ -55,17 +55,24 @@ def dataframe_to_dict(df: pd.DataFrame) -> dict:
     >>> sorted(d['feature0']['sub-feature-0'].values())
     [0, 4]
     """
-    data = df.copy()
-    if isinstance(data.index, pd.DatetimeIndex):
-        data.index = data.index.astype(str)
+    index = df.index
+    if isinstance(index, pd.DatetimeIndex):
+        keys = index.astype(str).tolist()
+    else:
+        keys = index.tolist()
     if isinstance(df.columns, pd.MultiIndex):
-        return {
-            col: data[col].to_dict()
-            if isinstance(data[col], pd.DataFrame)
-            else pd.DataFrame(data[col]).to_dict()
-            for col in data.columns.get_level_values(0)
-        }
-    return data.to_dict()
+        # column-at-a-time over raw numpy: orders of magnitude cheaper than
+        # repeated frame slicing + .to_dict() per level-0 block
+        out: dict = {}
+        for j, (top, sub) in enumerate(df.columns):
+            out.setdefault(top, {})[sub] = dict(
+                zip(keys, df.iloc[:, j].tolist())
+            )
+        return out
+    return {
+        col: dict(zip(keys, df.iloc[:, j].tolist()))
+        for j, col in enumerate(df.columns)
+    }
 
 
 def dataframe_from_dict(data: dict) -> pd.DataFrame:

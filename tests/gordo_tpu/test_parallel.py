@@ -83,6 +83,107 @@ def test_batched_model_scores_anomalies(batch_results):
     assert len(frame) == 20
 
 
+def _kfcv_block(name, n_tags=4, window=12):
+    return _machine_block(
+        name,
+        n_tags=n_tags,
+        model=f"""
+      gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector:
+        require_thresholds: true
+        window: {window}
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+            - sklearn.preprocessing.MinMaxScaler
+            - gordo_tpu.models.models.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: 1""",
+    )
+
+
+def test_kfcv_machines_take_batched_path():
+    from gordo_tpu.parallel.batch_trainer import _plan_machine
+
+    machines = _machines("machines:" + _kfcv_block("kf-0"))
+    plan = _plan_machine(machines[0])
+    assert plan is not None and plan.kfcv
+
+
+def test_kfcv_batched_build_end_to_end():
+    from gordo_tpu.models.anomaly.diff import DiffBasedKFCVAnomalyDetector
+
+    cfg = "machines:" + _kfcv_block("kf-a") + _kfcv_block("kf-b")
+    machines = _machines(cfg)
+    results = BatchedModelBuilder(machines, serial_fallback=False).build()
+    assert len(results) == 2
+    for model, machine_out in results:
+        assert isinstance(model, DiffBasedKFCVAnomalyDetector)
+        assert np.isfinite(model.aggregate_threshold_)
+        assert np.isfinite(model.feature_thresholds_).all()
+        md = machine_out.to_dict()["metadata"]["build_metadata"]["model"]
+        assert "aggregate-threshold" in md["model_meta"]
+    model, _ = results[0]
+    cols = [t.name for t in machines[0].dataset.tag_list]
+    idx = pd.date_range("2020-01-01", periods=30, freq="10min", tz="UTC")
+    X = pd.DataFrame(np.random.rand(30, 4), columns=cols, index=idx)
+    frame = model.anomaly(X, X, frequency=pd.Timedelta("10min"))
+    assert "total-anomaly-confidence" in frame.columns.get_level_values(0)
+
+
+def test_kfcv_threshold_math_matches_serial():
+    """_set_kfcv_thresholds must reproduce the serial KFCV detector's
+    percentile thresholds exactly, given the same fold predictions (here
+    from a deterministic LinearRegression base estimator)."""
+    from types import SimpleNamespace
+
+    from sklearn.linear_model import LinearRegression
+    from sklearn.model_selection import TimeSeriesSplit
+    from sklearn.preprocessing import MinMaxScaler
+
+    from gordo_tpu.models.anomaly.diff import DiffBasedKFCVAnomalyDetector
+
+    rng = np.random.RandomState(7)
+    X = rng.rand(300, 4)
+    y = X @ rng.rand(4, 4) + 0.01 * rng.rand(300, 4)
+
+    serial = DiffBasedKFCVAnomalyDetector(
+        base_estimator=LinearRegression(),
+        scaler=MinMaxScaler(),
+        window=24,
+        shuffle=False,
+    )
+    serial.cross_validate(
+        X=pd.DataFrame(X), y=pd.DataFrame(y), cv=TimeSeriesSplit(n_splits=3)
+    )
+
+    # batched-side replication from per-fold predictions
+    bounds, fold_preds = [], []
+    for train_idx, test_idx in TimeSeriesSplit(n_splits=3).split(X):
+        tr_end = int(train_idx[-1]) + 1
+        te_start, te_end = int(test_idx[0]), int(test_idx[-1]) + 1
+        bounds.append((tr_end, te_start, te_end))
+        lr = LinearRegression().fit(X[:tr_end], y[:tr_end])
+        fold_preds.append(lr.predict(X[te_start:te_end]))
+
+    batched = DiffBasedKFCVAnomalyDetector(
+        base_estimator=LinearRegression(),
+        scaler=MinMaxScaler(),
+        window=24,
+        shuffle=False,
+    )
+    BatchedModelBuilder._set_kfcv_thresholds(
+        None, batched, SimpleNamespace(y=y), fold_preds, bounds
+    )
+    np.testing.assert_allclose(
+        batched.aggregate_threshold_, serial.aggregate_threshold_, rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched.feature_thresholds_),
+        np.asarray(serial.feature_thresholds_),
+        rtol=1e-9,
+    )
+
+
 def test_heterogeneous_buckets_and_fallback():
     cfg = "machines:" + (
         _machine_block("small-0", n_tags=2)
