@@ -1,0 +1,35 @@
+# gordo-tpu build/test targets (reference parity: Makefile:1-40, collapsed
+# to the one image the TPU workflow actually uses)
+
+IMG_NAME ?= gordo-tpu
+DOCKER_REGISTRY ?= ghcr.io/gordo-tpu
+VERSION ?= $(shell python -c "import gordo_tpu; print(gordo_tpu.__version__)" 2>/dev/null || echo dev)
+
+# the single image every workflow pod runs (template {{ image }})
+image:
+	docker build . -f Dockerfile -t $(IMG_NAME):$(VERSION)
+
+push: image
+	docker tag $(IMG_NAME):$(VERSION) $(DOCKER_REGISTRY)/$(IMG_NAME):$(VERSION)
+	docker push $(DOCKER_REGISTRY)/$(IMG_NAME):$(VERSION)
+
+# full suite on the 8-virtual-device CPU mesh (how CI runs; conftest.py
+# forces JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8)
+test:
+	python -m pytest tests/ -q
+
+# multichip sharding compile check (same entry the driver uses)
+dryrun:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# render the example config through the real CLI and schema-validate the
+# resulting Workflow docs — the no-cluster equivalent of `argo lint`
+smoke:
+	python -m gordo_tpu.cli workflow generate \
+		--machine-config examples/config.yaml --project-name smoke-test \
+		| python -m gordo_tpu.cli workflow validate -
+
+bench:
+	python bench.py
+
+.PHONY: image push test dryrun smoke bench

@@ -137,11 +137,11 @@ LOOKBACK = 144
 _WINDOWED_FAMILIES = {
     "lstm_ae_144": (
         "gordo_tpu.models.models.LSTMAutoEncoder",
-        {"kind": "lstm_symmetric", "dims": [64, 32]},
+        {"kind": "lstm_symmetric", "dims": [64, 32], "funcs": ["tanh", "tanh"]},
     ),
     "lstm_forecast_144": (
         "gordo_tpu.models.models.LSTMForecast",
-        {"kind": "lstm_symmetric", "dims": [64, 32]},
+        {"kind": "lstm_symmetric", "dims": [64, 32], "funcs": ["tanh", "tanh"]},
     ),
     "transformer_144": (
         "gordo_tpu.models.models.TransformerAutoEncoder",
@@ -295,9 +295,10 @@ def _bench_windowed() -> dict:
 
     out = {}
     for family in _WINDOWED_FAMILIES:
+        slug = family.replace("_", "-")
         machines = [
             Machine.from_config(
-                _windowed_machine_config(f"{family}-{i:03d}", family),
+                _windowed_machine_config(f"{slug}-{i:03d}", family),
                 project_name="bench",
             )
             for i in range(N_WINDOWED)
@@ -438,6 +439,20 @@ def main():
             "falling back to CPU",
             file=sys.stderr,
         )
+        if os.environ.get("GORDO_TPU_BENCH_REEXEC") != "1":
+            # a wedged accelerator plugin blocks even the CPU platform
+            # in-process (plugin init runs at first device op), so the CPU
+            # fallback must be a clean interpreter without the plugin's
+            # site hook on PYTHONPATH
+            env = dict(os.environ)
+            env["GORDO_TPU_BENCH_REEXEC"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = os.pathsep.join(
+                p
+                for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p
+            )
+            os.execve(sys.executable, [sys.executable, __file__], env)
         jax.config.update("jax_platforms", "cpu")
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")

@@ -46,6 +46,7 @@ _MODEL_BLOCKS = {
               - gordo_tpu.models.models.LSTMAutoEncoder:
                   kind: lstm_symmetric
                   dims: [64, 32]
+                  funcs: [tanh, tanh]
                   lookback_window: 144
                   epochs: 1""",
 }

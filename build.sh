@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Pod entrypoint for builder workers (reference parity: build.sh:1-15).
+# Waits for the shared model volume, then runs the batched TPU build when the
+# pod carries a machine-list chunk ($MACHINES), or a single-machine build
+# ($MACHINE) for serial-path pods.
+set -e
+
+GORDO_MOUNT="${GORDO_MOUNT:-/gordo}"
+
+until mountpoint -q "$GORDO_MOUNT"; do
+    echo "$(date) - waiting for $GORDO_MOUNT to be mounted..."
+    sleep 1
+done
+
+ls -l "$GORDO_MOUNT"
+
+if [[ -n "${MACHINES}" ]]; then
+    gordo-tpu batch-build
+else
+    gordo-tpu build
+fi
+
+ls -l "$GORDO_MOUNT"

@@ -259,19 +259,74 @@ def workflow_cli():
     "machine runtime",
 )
 @click.option(
+    "--enable-postgres/--no-enable-postgres",
+    default=True,
+    envvar=f"{PREFIX}_ENABLE_POSTGRES",
+    help="Deploy a per-project Postgres (reporter sink) when no external "
+    "--postgres-host is given",
+)
+@click.option(
+    "--enable-influx/--no-enable-influx",
+    default=True,
+    envvar=f"{PREFIX}_ENABLE_INFLUX",
+    help="Deploy a per-project InfluxDB (client forwarder sink); also gated "
+    "by globals.runtime.influx.enable in the config",
+)
+@click.option(
+    "--enable-grafana/--no-enable-grafana",
+    default=True,
+    envvar=f"{PREFIX}_ENABLE_GRAFANA",
+    help="Deploy a per-project Grafana provisioned with the generated "
+    "dashboards",
+)
+@click.option(
     "--spot-tolerations/--no-spot-tolerations",
     default=True,
     envvar=f"{PREFIX}_SPOT_TOLERATIONS",
 )
+@click.option(
+    "--validate/--no-validate",
+    default=True,
+    envvar=f"{PREFIX}_VALIDATE",
+    help="Schema-validate the rendered Workflow docs (the in-framework "
+    "equivalent of the reference's `argo lint` gate)",
+)
 def workflow_generate_cli(**kwargs):
     """Generate workflow documents for a machine config."""
+    do_validate = kwargs.pop("validate", True)
     content = generate_workflow_docs(**kwargs)
+    if do_validate:
+        from gordo_tpu.workflow.validate import (
+            WorkflowValidationError,
+            validate_workflow_docs,
+        )
+
+        try:
+            validate_workflow_docs(content)
+        except WorkflowValidationError as exc:
+            raise click.ClickException(f"rendered workflow invalid: {exc}")
     output_file = kwargs.get("output_file")
     if output_file:
         with open(output_file, "w") as f:
             f.write(content)
     else:
         click.echo(content)
+
+
+@click.command("validate")
+@click.argument("workflow_file", type=click.File("r"), default="-")
+def workflow_validate_cli(workflow_file):
+    """Schema-validate rendered Workflow documents (file or stdin)."""
+    from gordo_tpu.workflow.validate import validate_workflow_docs
+
+    try:
+        validate_workflow_docs(workflow_file.read())
+    except Exception as exc:
+        raise click.ClickException(str(exc))
+    click.echo("workflow documents OK")
+
+
+workflow_cli.add_command(workflow_validate_cli)
 
 
 def _parse_custom_envs(raw: str) -> List[dict]:
@@ -332,6 +387,9 @@ def generate_workflow_docs(
     split_workflows: int = 30,
     exceptions_report_level: str = "MESSAGE",
     postgres_host: Optional[str] = None,
+    enable_postgres: bool = True,
+    enable_influx: bool = True,
+    enable_grafana: bool = True,
     spot_tolerations: bool = True,
     output_file: Optional[str] = None,
 ) -> str:
@@ -344,13 +402,26 @@ def generate_workflow_docs(
     config = get_dict_from_yaml(machine_config)
     norm = NormalizedConfig(config, project_name=project_name)
 
-    if postgres_host:
+    # postgres sink: an external host wins; otherwise the in-cluster
+    # per-project StatefulSet (enable_postgres) provides it
+    enable_postgres_deploy = enable_postgres and not postgres_host
+    effective_postgres_host = postgres_host or (
+        f"gordo-postgres-{project_name}" if enable_postgres else None
+    )
+    # influx side-deployment: CLI gate ANDed with the config's
+    # globals.runtime.influx.enable (reference behavior)
+    influx_cfg_enabled = bool(
+        (norm.globals.get("runtime", {}).get("influx") or {}).get("enable", True)
+    )
+    enable_influx = enable_influx and influx_cfg_enabled
+
+    if effective_postgres_host:
         for machine in norm.machines:
             reporters = machine.runtime.setdefault("reporters", [])
             reporters.append(
                 {
                     "gordo_tpu.reporters.postgres.PostgresReporter": {
-                        "host": postgres_host
+                        "host": effective_postgres_host
                     }
                 }
             )
@@ -422,6 +493,9 @@ def generate_workflow_docs(
             "staged_config_path": staged_config_path,
             "machines": machine_ctx,
             "enable_clients": enable_clients,
+            "enable_influx": enable_influx,
+            "enable_postgres_deploy": enable_postgres_deploy,
+            "enable_grafana": enable_grafana,
             "client_start_date": client_start_date,
             "client_end_date": client_end_date,
             "client_max_instances": norm.globals["runtime"]["client"][

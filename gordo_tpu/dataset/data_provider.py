@@ -126,21 +126,132 @@ class RandomDataProvider(GordoBaseDataProvider):
 @register_data_provider
 class InfluxDataProvider(GordoBaseDataProvider):
     """
-    Placeholder for the InfluxDB-backed provider. The interface is kept so
-    configs referencing it parse; actual network I/O is out of scope in this
-    environment (reference analog lives in gordo-dataset).
+    InfluxDB (1.x HTTP API) backed provider — the sink/source the workflow's
+    per-project influx side-deployment provides and the client's forwarder
+    writes into (reference analog lives in gordo-dataset).
+
+    One InfluxQL query per tag:
+    ``SELECT <value_name> FROM <measurement> WHERE <tag_key> = '<tag>' AND
+    time >= ... AND time < ...`` against ``GET /query`` — plain HTTP via
+    requests, no influx client library. A custom ``session`` can be injected
+    (used by tests; the same seam the gordo client uses for in-process WSGI).
     """
 
-    def __init__(self, measurement: str = "sensors", value_name: str = "Value", **kwargs):
+    def __init__(
+        self,
+        measurement: str = "sensors",
+        value_name: str = "Value",
+        tag_key: str = "tag",
+        uri: Optional[str] = None,
+        host: str = "localhost",
+        port: int = 8086,
+        database: str = "gordo",
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        scheme: str = "http",
+        session=None,
+        **kwargs,
+    ):
+        if uri:
+            # "scheme://host:port/database" shorthand
+            from urllib.parse import urlparse
+
+            parsed = urlparse(uri)
+            scheme = parsed.scheme or scheme
+            host = parsed.hostname or host
+            port = parsed.port or port
+            database = parsed.path.lstrip("/") or database
         self.measurement = measurement
         self.value_name = value_name
-        self._init_kwargs = dict(measurement=measurement, value_name=value_name, **kwargs)
-
-    def load_series(self, train_start_date, train_end_date, tag_list, dry_run=False):
-        raise NotImplementedError(
-            "InfluxDataProvider requires a live InfluxDB; use RandomDataProvider "
-            "or a custom provider in this environment."
+        self.tag_key = tag_key
+        self.base_url = f"{scheme}://{host}:{port}"
+        self.database = database
+        self.auth = (username, password) if username else None
+        self._session = session
+        self._init_kwargs = dict(
+            measurement=measurement,
+            value_name=value_name,
+            tag_key=tag_key,
+            host=host,
+            port=port,
+            database=database,
+            scheme=scheme,
+            # credentials must survive to_dict/from_dict: configs are the
+            # transport between generator and builder pods
+            username=username,
+            password=password,
+            **kwargs,
         )
+
+    @property
+    def session(self):
+        if self._session is None:
+            import requests
+
+            self._session = requests.Session()
+        return self._session
+
+    @staticmethod
+    def _influx_time(ts: datetime) -> str:
+        stamp = pd.Timestamp(ts)
+        stamp = (
+            stamp.tz_localize("UTC") if stamp.tzinfo is None
+            else stamp.tz_convert("UTC")
+        )
+        return stamp.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        for tag in tag_list:
+            # tag values are quoted with doubled single-quotes (InfluxQL
+            # string escaping) — tag names come from user config
+            safe_tag = tag.name.replace("'", "''")
+            query = (
+                f'SELECT "{self.value_name}" FROM "{self.measurement}" '
+                f"WHERE \"{self.tag_key}\" = '{safe_tag}' "
+                f"AND time >= '{self._influx_time(train_start_date)}' "
+                f"AND time < '{self._influx_time(train_end_date)}'"
+            )
+            if dry_run:
+                query += " LIMIT 1"
+            resp = self.session.get(
+                f"{self.base_url}/query",
+                params={"db": self.database, "q": query, "epoch": "ns"},
+                auth=self.auth,
+            )
+            if getattr(resp, "status_code", 200) != 200:
+                raise IOError(
+                    f"InfluxDB query failed ({resp.status_code}): "
+                    f"{getattr(resp, 'text', '')[:300]}"
+                )
+            payload = resp.json()
+            result = (payload.get("results") or [{}])[0]
+            if result.get("error"):
+                # InfluxQL statement errors come back as HTTP 200 with an
+                # error field — surface them, never treat as "no data"
+                raise IOError(
+                    f"InfluxDB query error for tag {tag.name!r}: "
+                    f"{result['error']}"
+                )
+            series_blocks = result.get("series") or []
+            if not series_blocks:
+                yield pd.Series(
+                    [], index=pd.DatetimeIndex([], tz="UTC"),
+                    dtype=np.float64, name=tag.name,
+                )
+                continue
+            block = series_blocks[0]
+            cols = block["columns"]
+            t_idx, v_idx = cols.index("time"), cols.index(self.value_name)
+            rows = block.get("values") or []
+            index = pd.to_datetime([r[t_idx] for r in rows], utc=True, unit="ns")
+            values = np.asarray([r[v_idx] for r in rows], dtype=np.float64)
+            yield pd.Series(values, index=index, name=tag.name)
 
 
 @register_data_provider

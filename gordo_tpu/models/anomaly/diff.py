@@ -31,6 +31,19 @@ from gordo_tpu.models.base import GordoBase
 from gordo_tpu.models.models import AutoEncoder
 
 
+def _scale_like(scaler, values: np.ndarray) -> np.ndarray:
+    """sklearn ``scaler.transform`` minus its per-call validation overhead
+    for the ubiquitous fitted MinMaxScaler (X * scale_ + min_ — sklearn's
+    exact formula); any other scaler goes through .transform."""
+    if (
+        type(scaler) is MinMaxScaler
+        and hasattr(scaler, "scale_")
+        and not getattr(scaler, "clip", False)
+    ):
+        return values * scaler.scale_ + scaler.min_
+    return np.asarray(scaler.transform(values))
+
+
 def _rolling_floor_peak(metric, window: int):
     """Max over the fold of the rolling minimum: a spike-tolerant ceiling for
     'normal' error. Returns a scalar for a Series metric, a per-column Series
@@ -273,8 +286,8 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         y_arr = np.asarray(getattr(y, "values", y), dtype=np.float64)[-n:]
         index = X.index[-n:] if hasattr(X, "index") else pd.RangeIndex(n)
 
-        out_scaled = np.asarray(self.scaler.transform(model_output))
-        y_scaled = np.asarray(self.scaler.transform(y))[-n:]
+        out_scaled = _scale_like(self.scaler, model_output)
+        y_scaled = _scale_like(self.scaler, np.asarray(getattr(y, "values", y)))[-n:]
         tag_anomaly_scaled = np.abs(out_scaled - y_scaled)
         total_anomaly_scaled = np.square(tag_anomaly_scaled).mean(axis=1)
         tag_anomaly_unscaled = np.abs(model_output - y_arr)

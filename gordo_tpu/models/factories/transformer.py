@@ -40,6 +40,7 @@ def transformer_model(
     out_func: str = "linear",
     causal: bool = True,
     pool: str = "last",
+    attention: str = "auto",
     optimizer: str = "Adam",
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compile_kwargs: Optional[Dict[str, Any]] = None,
@@ -54,6 +55,10 @@ def transformer_model(
         raise ValueError(
             f"transformer_model requires lookback_window >= 2, got {lookback_window}"
         )
+    if attention not in ("auto", "xla", "flash", "ring"):
+        raise ValueError(
+            f"attention must be one of auto|xla|flash|ring, got {attention!r}"
+        )
     layers = [
         DenseLayer(units=int(d_model), activation="linear"),
         PositionalEncoding(),
@@ -66,6 +71,7 @@ def transformer_model(
                 ff_dim=int(ff_dim),
                 activation=func,
                 causal=bool(causal),
+                attention_impl=attention,
             )
         )
     layers.append(PoolLayer(mode=pool))

@@ -51,6 +51,10 @@ class TransformerBlock:
     ff_dim: int = 128
     activation: str = "relu"
     causal: bool = False
+    # attention implementation: auto | xla | flash | ring
+    # (ring = sequence-parallel exact attention over the device mesh, for
+    # lookback windows too long for one chip — parallel/ring_attention.py)
+    attention_impl: str = "auto"
 
 
 @dataclass(frozen=True)

@@ -65,6 +65,78 @@ def dot_product_attention_xla(
     return jnp.einsum("...qk,...kd->...qd", weights, v)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _ring_fn(n_devices: int, causal: bool):
+    """Jitted ring attention over all local devices on a cached 'seq' mesh."""
+    from jax.sharding import Mesh
+
+    from gordo_tpu.parallel.ring_attention import make_ring_attention
+
+    mesh = Mesh(jax.devices()[:n_devices], ("seq",))
+    return make_ring_attention(mesh, seq_axis="seq", causal=causal)
+
+
+def _ring_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
+    """Whether ring attention can run: self-attention, >1 device, divisible T."""
+    n = len(jax.devices())
+    t = q.shape[-2]
+    return n > 1 and k.shape[-2] == t and t % n == 0
+
+
+def ring_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
+    """
+    Sequence-parallel exact attention: the time axis is sharded over ALL
+    devices and K/V blocks circulate the ring (parallel/ring_attention.py).
+    q, k, v: (..., T, Dh). T must divide by the device count.
+    """
+    n = len(jax.devices())
+    t, dh = q.shape[-2], q.shape[-1]
+    if n == 1:
+        # a 1-device ring is plain attention; lets ring-configured models
+        # serve on a single chip unchanged
+        return dot_product_attention_xla(q, k, v, causal=causal)
+    if not _ring_ok(q, k):
+        raise ValueError(
+            f"ring attention needs self-attention with T divisible by the "
+            f"device count (T={t}, devices={n}, k_len={k.shape[-2]})"
+        )
+    lead = q.shape[:-2]
+    fn = _ring_fn(n, causal)
+    out = fn(
+        q.reshape((-1, t, dh)), k.reshape((-1, t, dh)), v.reshape((-1, t, dh))
+    )
+    return out.reshape(lead + (t, dh))
+
+
+def spec_may_use_ring(spec) -> bool:
+    """Whether a ModelSpec's attention could resolve to the ring impl —
+    declared explicitly, forced via $GORDO_TPU_ATTENTION_IMPL, or reachable
+    through the opt-in auto-ring threshold. Ring is shard_map over the whole
+    mesh, so any vmapping caller (the fleet trainer's vmap-over-machines,
+    the serving batcher's vmap-over-models) must route such specs to its
+    non-vmapped path."""
+    impls = {
+        getattr(layer, "attention_impl", None)
+        for layer in getattr(spec, "layers", ())
+        if hasattr(layer, "attention_impl")
+    }
+    if not impls:
+        return False
+    if "ring" in impls:
+        return True
+    if os.environ.get("GORDO_TPU_ATTENTION_IMPL") == "ring" and "auto" in impls:
+        return True
+    threshold = os.environ.get("GORDO_TPU_RING_THRESHOLD")
+    return (
+        threshold is not None
+        and "auto" in impls
+        and spec.lookback_window >= int(threshold)
+    )
+
+
 def _flash_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     """
     Whether the Pallas flash kernel supports these shapes on this backend.
@@ -94,7 +166,21 @@ def dot_product_attention(
     """
     impl = impl or _default_impl()
     if impl == "auto":
-        impl = "flash" if _flash_ok(q, k) else "xla"
+        # opt-in auto-ring: past $GORDO_TPU_RING_THRESHOLD rows the window is
+        # taken to exceed one chip and the sequence goes over the mesh. Kept
+        # opt-in because ring (shard_map) cannot run under the fleet
+        # trainer's vmap-over-machines.
+        ring_threshold = os.environ.get("GORDO_TPU_RING_THRESHOLD")
+        if (
+            ring_threshold is not None
+            and q.shape[-2] >= int(ring_threshold)
+            and _ring_ok(q, k)
+        ):
+            impl = "ring"
+        else:
+            impl = "flash" if _flash_ok(q, k) else "xla"
+    if impl == "ring":
+        return ring_attention(q, k, v, causal=causal)
     if impl == "flash":
         from gordo_tpu.ops.pallas_kernels.flash_attention import flash_attention
 

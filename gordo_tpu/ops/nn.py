@@ -225,7 +225,17 @@ def _apply_transformer_block(layer: TransformerBlock, p, x):
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
     v = h @ p["wv"] + p["bv"]
-    attn = multihead_attention(q, k, v, layer.num_heads, causal=layer.causal)
+    # an explicit per-layer impl pins the choice; "auto" defers to the
+    # dispatcher (and its GORDO_TPU_ATTENTION_IMPL env override)
+    layer_impl = getattr(layer, "attention_impl", "auto")
+    attn = multihead_attention(
+        q,
+        k,
+        v,
+        layer.num_heads,
+        causal=layer.causal,
+        impl=None if layer_impl == "auto" else layer_impl,
+    )
     x = x + attn @ p["wo"] + p["bo"]
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = _activation(layer.activation)(h @ p["w_ff1"] + p["b_ff1"])

@@ -1,19 +1,24 @@
 """
 Flash attention for TPU in Pallas: blockwise online-softmax attention that
-never materializes the (T, T) score matrix in HBM.
+never materializes the (T, T) score matrix in HBM — forward AND backward.
 
 Design (see /opt/skills/guides/pallas_guide.md):
-- Grid: (batch*heads, T // BLOCK_Q). Each program owns one query block in
-  VMEM; K/V for its (batch, head) slice are staged into VMEM whole, and the
-  kernel loops over key blocks with the standard running (max, denom, acc)
-  online-softmax update. Score blocks are (BLOCK_Q, BLOCK_K) fp32 — VPU-sized
-  — and the two matmuls per block ride the MXU.
+- Forward grid: (batch*heads, T // BLOCK_Q). Each program owns one query
+  block in VMEM; K/V for its (batch, head) slice are staged into VMEM whole,
+  and the kernel loops over key blocks with the standard running
+  (max, denom, acc) online-softmax update. Score blocks are
+  (BLOCK_Q, BLOCK_K) fp32 — VPU-sized — and the two matmuls per block ride
+  the MXU. The forward also emits the per-row logsumexp, the only residual
+  the backward needs beyond q/k/v/o.
+- Backward: two kernels sharing the forward's blocking, both O(T) memory:
+  a dQ kernel (grid over query blocks, loop over key blocks) and a dK/dV
+  kernel (grid over key blocks, loop over query blocks). Each recomputes its
+  score block as P = exp(S - lse) — no stored probabilities, no O(T²)
+  anything — and uses the FlashAttention-2 identity
+  dS = P ∘ (dP − D) with D = rowsum(dO ∘ O).
 - Accumulation in float32 regardless of input dtype (bfloat16-safe).
-- Backward: ``jax.custom_vjp`` recomputing the XLA reference attention —
-  exact gradients (the kernel is numerically equivalent), O(T²) memory only
-  inside the backward pass. A fused backward kernel is a future optimization.
 
-The kernel runs under ``interpret=True`` on CPU so tests exercise the real
+The kernels run under ``interpret=True`` on CPU so tests exercise the real
 kernel logic without TPU hardware.
 """
 
@@ -29,8 +34,8 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                  block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                  causal: bool, block_k: int):
     """One query block vs all key blocks, online softmax."""
     q = q_ref[0].astype(jnp.float32)  # (BLOCK_Q, Dh)
     block_q, dh = q.shape
@@ -60,12 +65,91 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
-    _, l_fin, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    m_fin, l_fin, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = (m_fin + jnp.log(jnp.maximum(l_fin, 1e-30)))[:, 0]
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     *, scale: float, causal: bool, block_k: int):
+    """dQ for one query block: loop over key blocks, recomputing P from lse."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]       # (BLOCK_Q, 1)
+    delta = delta_ref[0][:, None]   # (BLOCK_Q, 1)
+    block_q, dh = q.shape
+    t_k = k_ref.shape[1]
+    n_kb = t_k // block_k
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k_blk.T) * scale
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # (BLOCK_Q, BLOCK_K)
+        dp = do @ v_blk.T                          # (BLOCK_Q, BLOCK_K)
+        ds = p * (dp - delta)
+        return dq + (ds @ k_blk) * scale
+
+    dq0 = jnp.zeros((block_q, dh), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, n_kb, body, dq0).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, scale: float, causal: bool,
+                      block_q: int):
+    """dK/dV for one key block: loop over query blocks."""
+    k_blk = k_ref[0].astype(jnp.float32)   # (BLOCK_K, Dh)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k, dh = k_blk.shape
+    t_q = q_ref.shape[1]
+    n_qb = t_q // block_q
+    ki = pl.program_id(1)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = (q @ k_blk.T) * scale          # (BLOCK_Q, BLOCK_K)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + p.T @ do
+        dp = do @ v_blk.T
+        ds = p * (dp - delta)
+        dk = dk + (ds.T @ q) * scale
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, dh), jnp.float32)
+    dv0 = jnp.zeros((block_k, dh), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _block_sizes(t: int):
+    block_q = min(BLOCK_Q, t)
+    block_k = min(BLOCK_K, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"sequence length {t} must be divisible by {block_q}")
+    return block_q, block_k
 
 
 def _flash_forward(q, k, v, causal: bool, interpret: bool):
-    """q, k, v: (BH, T, Dh) — flattened leading batch*heads axis."""
+    """q, k, v: (BH, T, Dh) — flattened leading batch*heads axis.
+    Returns (out, lse)."""
     bh, t, dh = q.shape
     if k.shape[1] != t or v.shape[1] != t:
         # the kernel's key-block loop and causal mask assume start-aligned
@@ -74,10 +158,7 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
             f"flash_attention requires equal Q/K/V sequence lengths, got "
             f"q={t}, k={k.shape[1]}, v={v.shape[1]}"
         )
-    block_q = min(BLOCK_Q, t)
-    block_k = min(BLOCK_K, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"sequence length {t} must be divisible by {block_q}")
+    block_q, block_k = _block_sizes(t)
     scale = 1.0 / (dh**0.5)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_k=block_k
@@ -90,29 +171,88 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
             pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 
 
+def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
+    """Fused O(T)-memory backward: returns (dq, dk, dv)."""
+    bh, t, dh = q.shape
+    block_q, block_k = _block_sizes(t)
+    scale = 1.0 / (dh**0.5)
+    # D_i = rowsum(dO ∘ O): tiny (BH, T) tensor, cheapest outside the kernels
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    full = lambda b, i: (b, 0, 0)
+    rows = lambda b, i: (b, 0)
+    dq_kernel = functools.partial(
+        _flash_dq_kernel, scale=scale, causal=causal, block_k=block_k
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, dh), full),
+            pl.BlockSpec((1, t, dh), full),
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel, scale=scale, causal=causal, block_q=block_q
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, dh), full),
+            pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, t, dh), full),
+            pl.BlockSpec((1, t), rows),
+            pl.BlockSpec((1, t), rows),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal, interpret)
+    out, _ = _flash_forward(q, k, v, causal, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, interpret, residuals, g):
-    from gordo_tpu.ops.attention import dot_product_attention_xla
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention_xla(q, k, v, causal=causal), q, k, v
-    )
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_backward(q, k, v, o, lse, g, causal, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)

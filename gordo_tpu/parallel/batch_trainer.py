@@ -220,6 +220,12 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         # windowed KFCV scatter-fill needs aligned prediction rows; the
         # serial path has the same restriction (length-mismatched .iloc set)
         return None
+    from gordo_tpu.ops.attention import spec_may_use_ring
+
+    if spec_may_use_ring(spec):
+        # ring attention is shard_map over the whole mesh — it cannot run
+        # under this builder's vmap-over-machines; serial path owns it
+        return None
 
     return _Plan(
         machine=machine,
@@ -502,7 +508,12 @@ class BatchedModelBuilder:
         own share (the SPMD replacement for one-pod-per-machine fan-out).
         """
         from gordo_tpu.parallel import distributed
+        from gordo_tpu.util.profiling import maybe_profile
 
+        with maybe_profile("batched-build"):
+            return self._build_all(distributed)
+
+    def _build_all(self, distributed) -> List[Tuple[Any, Machine]]:
         results: Dict[int, Tuple[Any, Machine]] = {}
         plans: Dict[int, _Plan] = {}
         serial: List[int] = []

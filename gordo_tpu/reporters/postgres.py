@@ -78,6 +78,13 @@ class PostgresReporter(BaseReporter):
             raise ValueError(
                 "PostgresReporter needs host= or connection_factory="
             )
+        if password is None:
+            # the workflow's in-cluster postgres injects its generated
+            # secret here (template: GORDO_TPU_POSTGRES_PASSWORD from
+            # secretKeyRef), so configs never carry the credential
+            import os
+
+            password = os.environ.get("GORDO_TPU_POSTGRES_PASSWORD")
         self.host = host
         self.port = port
         self.user = user

@@ -115,7 +115,11 @@ class _ParamBank:
         slot = len(self.trees)
         self.trees.append(params)  # keeps `params` alive, so id() stays unique
         self.slots[key] = slot
-        cap = 1
+        # capacity floor of 8: growing 1->2->4->8 would recompile the gather
+        # program at every step while a server warms its first models. The
+        # padding copies cost <=8x ONE model's params in HBM (<<1MB for this
+        # model zoo) — accepted for the compile stability
+        cap = 8
         while cap < len(self.trees):
             cap <<= 1
         if cap == self.capacity:
@@ -214,13 +218,13 @@ class CrossModelBatcher:
         n = len(items)
         # few fixed batch buckets per (spec, shape): every new bucket is a
         # fresh XLA compile at serving time (measured as multi-second p95
-        # spikes in the A/B bench), while padding costs only idle vmap lanes
-        if n == 1:
-            b_pad = 1
-        elif n <= 8:
-            b_pad = min(8, self.max_batch)
-        else:
-            b_pad = self.max_batch
+        # spikes in the A/B bench). Buckets grow 4x so padding waste stays
+        # under 4x even for compute-heavy (windowed) specs, where idle vmap
+        # lanes are real FLOPs, not noise.
+        b_pad = 1
+        while b_pad < min(n, self.max_batch):
+            b_pad <<= 2
+        b_pad = min(b_pad, self.max_batch)
         bank = self._banks.setdefault(spec, _ParamBank())
         gen = bank.generation
         slots = [bank.slot_of(it.params) for it in items]
@@ -283,5 +287,11 @@ def maybe_submit(spec, params, X) -> Optional[np.ndarray]:
     if batcher is None:
         return None
     if threading.current_thread().name == "gordo-batcher":
+        return None
+    from gordo_tpu.ops.attention import spec_may_use_ring
+
+    if spec_may_use_ring(spec):
+        # ring attention (shard_map) cannot run under this batcher's
+        # vmap-over-models; such specs always predict direct
         return None
     return batcher.submit(spec, params, X)

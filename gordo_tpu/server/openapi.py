@@ -1,0 +1,207 @@
+"""
+OpenAPI document for the model-server REST surface.
+
+The reference exposes a swagger spec through flask-restplus
+(gordo/server/rest_api.py:6-14); here the spec is a plain data structure
+(no framework dependency) served at ``/gordo/v0/openapi.json`` and kept
+honest by tests that diff its paths against the live URL map.
+"""
+
+from gordo_tpu import __version__
+
+_DF_DICT = {
+    "type": "object",
+    "description": "Dataframe as {column: {index: value}} "
+    "(MultiIndex columns nest one level deeper)",
+    "additionalProperties": True,
+}
+
+_PREDICTION_BODY = {
+    "required": True,
+    "content": {
+        "application/json": {
+            "schema": {
+                "type": "object",
+                "required": ["X"],
+                "properties": {
+                    "X": _DF_DICT,
+                    "y": _DF_DICT,
+                },
+            }
+        },
+        "multipart/form-data": {
+            "schema": {
+                "type": "object",
+                "required": ["X"],
+                "properties": {
+                    "X": {"type": "string", "format": "binary",
+                          "description": "snappy-parquet dataframe"},
+                    "y": {"type": "string", "format": "binary"},
+                },
+            }
+        },
+    },
+}
+
+_REVISION_PARAM = {
+    "name": "revision",
+    "in": "query",
+    "required": False,
+    "schema": {"type": "string"},
+    "description": "Serve a specific model revision (410 when absent)",
+}
+
+_FORMAT_PARAM = {
+    "name": "format",
+    "in": "query",
+    "required": False,
+    "schema": {"type": "string", "enum": ["parquet"]},
+    "description": "Return snappy-parquet bytes instead of JSON",
+}
+
+_PROJECT_PARAM = {
+    "name": "gordo_project",
+    "in": "path",
+    "required": True,
+    "schema": {"type": "string"},
+}
+_NAME_PARAM = {
+    "name": "gordo_name",
+    "in": "path",
+    "required": True,
+    "schema": {"type": "string"},
+}
+
+_RESPONSE_FRAME = {
+    "200": {
+        "description": "Prediction frame (data key) or parquet bytes",
+        "content": {"application/json": {"schema": _DF_DICT}},
+    },
+    "400": {"description": "Bad payload / missing X or y"},
+    "404": {"description": "No such model"},
+    "410": {"description": "Requested revision not available"},
+}
+
+
+def openapi_document() -> dict:
+    """The spec as a dict; serialized by the /openapi.json route."""
+    machine = f"/gordo/v0/{{gordo_project}}/{{gordo_name}}"
+    project = "/gordo/v0/{gordo_project}"
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "gordo-tpu model server",
+            "version": __version__,
+            "description": "Config-driven timeseries anomaly model serving "
+            "(route/payload-compatible with Equinor gordo's server)",
+        },
+        "paths": {
+            f"{machine}/prediction": {
+                "post": {
+                    "summary": "Run the model's predict/transform over X",
+                    "parameters": [
+                        _PROJECT_PARAM, _NAME_PARAM, _REVISION_PARAM,
+                        _FORMAT_PARAM,
+                    ],
+                    "requestBody": _PREDICTION_BODY,
+                    "responses": _RESPONSE_FRAME,
+                }
+            },
+            f"{machine}/anomaly/prediction": {
+                "post": {
+                    "summary": "Score anomalies (requires y; diff-based "
+                    "detectors only)",
+                    "parameters": [
+                        _PROJECT_PARAM, _NAME_PARAM, _REVISION_PARAM,
+                        _FORMAT_PARAM,
+                        {
+                            "name": "all_columns",
+                            "in": "query",
+                            "required": False,
+                            "schema": {"type": "string"},
+                            "description": "Include smoothed columns",
+                        },
+                    ],
+                    "requestBody": _PREDICTION_BODY,
+                    "responses": {
+                        **_RESPONSE_FRAME,
+                        "422": {
+                            "description": "Model is not an anomaly detector"
+                        },
+                    },
+                }
+            },
+            f"{machine}/metadata": {
+                "get": {
+                    "summary": "Machine + build metadata",
+                    "parameters": [_PROJECT_PARAM, _NAME_PARAM, _REVISION_PARAM],
+                    "responses": {"200": {"description": "Metadata document"}},
+                }
+            },
+            f"{machine}/download-model": {
+                "get": {
+                    "summary": "Serialized model artifact",
+                    "parameters": [_PROJECT_PARAM, _NAME_PARAM, _REVISION_PARAM],
+                    "responses": {
+                        "200": {
+                            "description": "Serialized model bytes",
+                            "content": {
+                                "application/octet-stream": {
+                                    "schema": {
+                                        "type": "string", "format": "binary"
+                                    }
+                                }
+                            },
+                        }
+                    },
+                }
+            },
+            f"{project}/models": {
+                "get": {
+                    "summary": "Model names in the served revision",
+                    "parameters": [_PROJECT_PARAM, _REVISION_PARAM],
+                    "responses": {"200": {"description": "{models: [...]}"}},
+                }
+            },
+            f"{project}/revisions": {
+                "get": {
+                    "summary": "Available model-collection revisions",
+                    "parameters": [_PROJECT_PARAM],
+                    "responses": {
+                        "200": {
+                            "description":
+                            "{latest, available-revisions}"
+                        }
+                    },
+                }
+            },
+            f"{project}/expected-models": {
+                "get": {
+                    "summary": "Models the deployment expects to serve",
+                    "parameters": [_PROJECT_PARAM],
+                    "responses": {
+                        "200": {"description": "{expected-models: [...]}"}
+                    },
+                }
+            },
+            "/gordo/v0/openapi.json": {
+                "get": {
+                    "summary": "This document",
+                    "responses": {"200": {"description": "OpenAPI 3.0 spec"}},
+                }
+            },
+            "/healthcheck": {
+                "get": {"summary": "Liveness probe",
+                        "responses": {"200": {"description": "OK"}}}
+            },
+            "/server-version": {
+                "get": {"summary": "Server version",
+                        "responses": {"200": {"description": "{version}"}}}
+            },
+            "/metrics": {
+                "get": {"summary": "Prometheus metrics (when enabled)",
+                        "responses": {"200": {"description": "text format"},
+                                      "404": {"description": "disabled"}}}
+            },
+        },
+    }

@@ -59,6 +59,7 @@ class GordoServer:
             Rule("/healthcheck", endpoint="healthcheck"),
             Rule("/server-version", endpoint="server_version"),
             Rule("/metrics", endpoint="metrics"),
+            Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
             Rule(
                 "/gordo/v0/<gordo_project>/models",
                 endpoint="model_list",
@@ -166,6 +167,13 @@ class GordoServer:
                     response = Response("", status=200)
                 elif endpoint == "server_version":
                     response = views.json_response(ctx, {"version": __version__})
+                elif endpoint == "openapi_spec":
+                    from gordo_tpu.server.openapi import openapi_document
+
+                    response = Response(
+                        simplejson.dumps(openapi_document()),
+                        mimetype="application/json",
+                    )
                 elif endpoint == "metrics":
                     if self._prometheus is None:
                         response = Response("metrics disabled", status=404)

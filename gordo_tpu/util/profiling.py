@@ -1,0 +1,46 @@
+"""
+Opt-in JAX profiler / XLA-dump hookup.
+
+The reference's tracing story is wall-clock only (Server-Timing headers,
+build durations in metadata — SURVEY.md §5); on TPU the equivalents that
+actually matter are device traces and compiled-program dumps:
+
+- ``GORDO_TPU_PROFILE_DIR=/path``: wraps the batched fleet build (and any
+  code under :func:`maybe_profile`) in ``jax.profiler.trace`` — open the
+  result with TensorBoard or Perfetto to see per-op device timelines,
+  HBM traffic, and host/device overlap.
+- ``XLA_FLAGS=--xla_dump_to=/path``: XLA's own HLO dump (handled by XLA
+  itself; listed here because it is the other half of the toolkit).
+"""
+
+import contextlib
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+PROFILE_DIR_ENV = "GORDO_TPU_PROFILE_DIR"
+
+
+@contextlib.contextmanager
+def maybe_profile(label: str):
+    """Trace the enclosed block when $GORDO_TPU_PROFILE_DIR is set."""
+    profile_dir = os.environ.get(PROFILE_DIR_ENV)
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    target = os.path.join(profile_dir, label)
+    os.makedirs(target, exist_ok=True)
+    logger.info("jax profiler tracing %s -> %s", label, target)
+    with jax.profiler.trace(target):
+        yield
+    logger.info("profile written: %s (open with TensorBoard/Perfetto)", target)
+
+
+def annotate(name: str):
+    """Named sub-span inside an active trace (no-op when not tracing)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,196 @@
+"""
+Schema-level validation of rendered Argo Workflow documents.
+
+The reference gates every deploy behind ``argo lint`` of the generated
+workflow (run_workflow_and_argo.sh:28), which needs a live cluster. This
+validator re-provides that gate as pure structural checks runnable in CI and
+tests: CRD shape, template-name uniqueness and reference integrity (incl.
+DAG dependency cycles), k8s DNS-1123 naming, container/env/volume sanity.
+It is intentionally stricter than YAML-parse round-trips — every failure
+class listed here has produced a broken deploy from a *parseable* template.
+
+Wired into ``gordo-tpu workflow validate`` (stdin or file) and callable as
+:func:`validate_workflow_docs` from tests and the smoke script.
+"""
+
+import re
+from typing import Any, Dict, List
+
+import yaml
+
+DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+ENV_NAME = re.compile(r"^[-._a-zA-Z][-._a-zA-Z0-9]*$")
+
+_TEMPLATE_KINDS = ("container", "script", "dag", "steps", "resource", "suspend")
+
+
+class WorkflowValidationError(ValueError):
+    """Raised with every problem found, one per line."""
+
+
+def _check_name(value: str, what: str, errors: List[str], max_len: int = 63):
+    if not isinstance(value, str) or not value:
+        errors.append(f"{what}: missing or empty name")
+        return
+    if len(value) > max_len:
+        errors.append(f"{what}: name {value!r} exceeds {max_len} chars")
+    if not DNS1123.match(value):
+        errors.append(f"{what}: name {value!r} is not DNS-1123")
+
+
+def _check_container(c: Dict[str, Any], where: str, errors: List[str]):
+    if not c.get("image"):
+        errors.append(f"{where}: container has no image")
+    for env in c.get("env") or []:
+        name = env.get("name")
+        if not name or not ENV_NAME.match(str(name)):
+            errors.append(f"{where}: invalid env var name {name!r}")
+        if "value" in env and env["value"] is not None and not isinstance(
+            env["value"], str
+        ):
+            errors.append(
+                f"{where}: env {name} value must be a string, got "
+                f"{type(env['value']).__name__} (quote it in the template)"
+            )
+    for vm in c.get("volumeMounts") or []:
+        if not vm.get("name") or not vm.get("mountPath"):
+            errors.append(f"{where}: volumeMount needs name and mountPath")
+
+
+def _check_dag(dag: Dict[str, Any], tmpl_name: str, template_names: set,
+               errors: List[str]):
+    tasks = dag.get("tasks") or []
+    task_names = set()
+    deps: Dict[str, List[str]] = {}
+    for task in tasks:
+        t_name = task.get("name")
+        _check_name(str(t_name), f"dag {tmpl_name} task", errors)
+        if t_name in task_names:
+            errors.append(f"dag {tmpl_name}: duplicate task name {t_name!r}")
+        task_names.add(t_name)
+        ref = task.get("template") or (task.get("templateRef") or {}).get("name")
+        if task.get("template") and task["template"] not in template_names:
+            errors.append(
+                f"dag {tmpl_name} task {t_name}: references undefined "
+                f"template {task['template']!r}"
+            )
+        elif not ref:
+            errors.append(f"dag {tmpl_name} task {t_name}: no template ref")
+        raw = task.get("dependencies") or []
+        if isinstance(raw, str):
+            raw = raw.split()
+        deps[t_name] = list(raw)
+    for t_name, dd in deps.items():
+        for d in dd:
+            if d not in task_names:
+                errors.append(
+                    f"dag {tmpl_name} task {t_name}: depends on undefined "
+                    f"task {d!r}"
+                )
+    # cycle detection (iterative DFS, 3-color)
+    color: Dict[str, int] = {}
+
+    def visit(node: str) -> bool:
+        stack = [(node, iter(deps.get(node, ())))]
+        color[node] = 1
+        while stack:
+            cur, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color.get(nxt, 0) == 1:
+                    return True
+                if color.get(nxt, 0) == 0 and nxt in deps:
+                    color[nxt] = 1
+                    stack.append((nxt, iter(deps.get(nxt, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[cur] = 2
+                stack.pop()
+        return False
+
+    for t_name in deps:
+        if color.get(t_name, 0) == 0 and visit(t_name):
+            errors.append(f"dag {tmpl_name}: dependency cycle involving {t_name!r}")
+            break
+
+
+def validate_workflow_doc(doc: Dict[str, Any]) -> List[str]:
+    """Validate one parsed Workflow document; returns a list of problems."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a mapping"]
+    if doc.get("apiVersion") != "argoproj.io/v1alpha1":
+        errors.append(f"unexpected apiVersion {doc.get('apiVersion')!r}")
+    if doc.get("kind") != "Workflow":
+        errors.append(f"unexpected kind {doc.get('kind')!r}")
+    meta = doc.get("metadata") or {}
+    name = meta.get("name")
+    gen_name = meta.get("generateName")
+    if name:
+        _check_name(name, "metadata", errors)
+    elif gen_name:
+        _check_name(gen_name.rstrip("-"), "metadata.generateName", errors)
+    else:
+        errors.append("metadata: needs name or generateName")
+
+    spec = doc.get("spec") or {}
+    templates = spec.get("templates") or []
+    names: List[str] = []
+    for tmpl in templates:
+        t_name = tmpl.get("name")
+        _check_name(str(t_name), "template", errors)
+        names.append(t_name)
+        kinds = [k for k in _TEMPLATE_KINDS if tmpl.get(k) is not None]
+        if len(kinds) != 1:
+            errors.append(
+                f"template {t_name}: needs exactly one of {_TEMPLATE_KINDS}, "
+                f"has {kinds or 'none'}"
+            )
+    dupes = {n for n in names if names.count(n) > 1}
+    for d in dupes:
+        errors.append(f"duplicate template name {d!r}")
+    template_names = set(names)
+
+    entrypoint = spec.get("entrypoint")
+    if not entrypoint:
+        errors.append("spec.entrypoint missing")
+    elif entrypoint not in template_names:
+        errors.append(f"spec.entrypoint {entrypoint!r} not a defined template")
+    on_exit = spec.get("onExit")
+    if on_exit and on_exit not in template_names:
+        errors.append(f"spec.onExit {on_exit!r} not a defined template")
+
+    spec_volumes = {v.get("name") for v in spec.get("volumes") or []}
+    for tmpl in templates:
+        t_name = tmpl.get("name")
+        for kind in ("container", "script"):
+            if tmpl.get(kind):
+                _check_container(tmpl[kind], f"template {t_name}", errors)
+                local_volumes = {
+                    v.get("name") for v in tmpl.get("volumes") or []
+                }
+                for vm in tmpl[kind].get("volumeMounts") or []:
+                    if vm.get("name") not in spec_volumes | local_volumes:
+                        errors.append(
+                            f"template {t_name}: volumeMount "
+                            f"{vm.get('name')!r} has no matching volume"
+                        )
+        if tmpl.get("dag"):
+            _check_dag(tmpl["dag"], t_name, template_names, errors)
+    return errors
+
+
+def validate_workflow_docs(text: str) -> None:
+    """Validate every YAML document in ``text``; raise with all problems."""
+    problems: List[str] = []
+    docs = [d for d in yaml.safe_load_all(text) if d is not None]
+    if not docs:
+        raise WorkflowValidationError("no YAML documents found")
+    for i, doc in enumerate(docs):
+        for problem in validate_workflow_doc(doc):
+            problems.append(f"doc[{i}]: {problem}")
+    if problems:
+        raise WorkflowValidationError(
+            f"{len(problems)} problem(s):\n" + "\n".join(problems)
+        )

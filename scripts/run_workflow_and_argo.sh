@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Generate → validate → (lint) → optionally submit the workflow
+# (reference parity: run_workflow_and_argo.sh:1-35, with the in-framework
+# schema validator replacing the hard dependency on a live cluster for lint).
+set -e
+if [[ -n "${DEBUG_SHOW_WORKFLOW}" ]]; then
+  set -x
+fi
+
+CONFIG_FILE=/tmp/config.yml
+GENERATED=/tmp/generated-config.yml
+
+if [[ -z "${MACHINE_CONFIG}" && -z "${GORDO_NAME}" ]]; then
+    echo "Set MACHINE_CONFIG (inline YAML) or GORDO_NAME (Gordo CRD name)" >&2
+    exit 64
+elif [[ -z "${MACHINE_CONFIG}" ]]; then
+    kubectl get gordos "${GORDO_NAME}" -o json > "$CONFIG_FILE"
+else
+    echo "$MACHINE_CONFIG" > "$CONFIG_FILE"
+fi
+
+if [[ -n "${DEBUG_SHOW_WORKFLOW}" ]]; then
+  echo "===CONFIG==="; cat "$CONFIG_FILE"
+fi
+
+gordo-tpu workflow generate \
+    --machine-config "$CONFIG_FILE" \
+    --project-name "${PROJECT_NAME:?PROJECT_NAME must be set}" \
+    --output-file "$GENERATED"
+
+if [[ -n "${DEBUG_SHOW_WORKFLOW}" ]]; then
+  echo "===GENERATED==="; cat "$GENERATED"
+fi
+
+# schema validation always runs (no cluster needed); argo lint adds
+# cluster-side checks when an API server is reachable
+gordo-tpu workflow validate "$GENERATED"
+if command -v argo >/dev/null && argo version >/dev/null 2>&1; then
+    argo lint "$GENERATED" || {
+        echo "argo lint failed" >&2
+        exit 1
+    }
+fi
+
+if [[ "$ARGO_SUBMIT" == "true" ]]; then
+    if [[ -n "$ARGO_SERVICE_ACCOUNT" ]]; then
+        argo submit --serviceaccount "$ARGO_SERVICE_ACCOUNT" "$GENERATED"
+    else
+        argo submit "$GENERATED"
+    fi
+fi

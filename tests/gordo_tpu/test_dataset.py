@@ -135,3 +135,67 @@ def test_to_dict_preserves_interpolation():
     assert d["interpolation_limit"] == "48h"
     ds2 = GordoBaseDataset.from_dict(d)
     assert ds2.interpolation_limit == "48h"
+
+
+def test_influx_provider_queries_and_parses():
+    """InfluxDataProvider speaks the 1.x /query API; stub session, no network."""
+    from datetime import datetime, timezone
+
+    import numpy as np
+    import pandas as pd
+
+    from gordo_tpu.dataset.data_provider import (
+        GordoBaseDataProvider,
+        InfluxDataProvider,
+    )
+    from gordo_tpu.dataset.sensor_tag import SensorTag
+
+    calls = []
+
+    class StubResp:
+        status_code = 200
+
+        def json(self):
+            base = pd.Timestamp("2019-01-01", tz="UTC").value
+            return {
+                "results": [
+                    {
+                        "series": [
+                            {
+                                "columns": ["time", "Value"],
+                                "values": [
+                                    [base, 1.5],
+                                    [base + 600_000_000_000, 2.5],
+                                ],
+                            }
+                        ]
+                    }
+                ]
+            }
+
+    class StubSession:
+        def get(self, url, params=None, auth=None):
+            calls.append((url, params))
+            return StubResp()
+
+    provider = InfluxDataProvider(
+        uri="http://influx.example:8086/proj-db", session=StubSession()
+    )
+    start = datetime(2019, 1, 1, tzinfo=timezone.utc)
+    end = datetime(2019, 1, 2, tzinfo=timezone.utc)
+    tag = SensorTag("pump's-sensor", asset="a")
+    (series,) = list(provider.load_series(start, end, [tag]))
+
+    url, params = calls[0]
+    assert url == "http://influx.example:8086/query"
+    assert params["db"] == "proj-db"
+    assert "pump''s-sensor" in params["q"]  # InfluxQL quote escaping
+    assert "time >= '2019-01-01T00:00:00.000000Z'" in params["q"]
+    assert series.name == tag.name
+    np.testing.assert_allclose(series.to_numpy(), [1.5, 2.5])
+    assert series.index.tz is not None
+
+    # config round-trip through the registry
+    rebuilt = GordoBaseDataProvider.from_dict(provider.to_dict())
+    assert isinstance(rebuilt, InfluxDataProvider)
+    assert rebuilt.database == "proj-db"

@@ -277,3 +277,25 @@ def test_revision_traversal_rejected(client, gordo_project):
     for bad in ("../../../../etc", "..", "a/b", "foo%2F..%2Fbar"):
         resp = client.get(f"/gordo/v0/{gordo_project}/models?revision={bad}")
         assert resp.status_code == 410, bad
+
+
+def test_openapi_spec_matches_url_map(client):
+    resp = client.get("/gordo/v0/openapi.json")
+    assert resp.status_code == 200
+    spec = resp.get_json()
+    assert spec["openapi"].startswith("3.")
+
+    # every URL rule must be documented, and vice versa
+    from gordo_tpu.server.server import GordoServer
+
+    def to_openapi(rule_str):
+        return rule_str.replace("<", "{").replace(">", "}")
+
+    rule_paths = {
+        to_openapi(r.rule)
+        for r in GordoServer.url_map.iter_rules()
+        # per-machine healthcheck aliases metadata; not separately documented
+        if not r.rule.endswith("/<gordo_name>/healthcheck")
+    }
+    spec_paths = set(spec["paths"])
+    assert rule_paths <= spec_paths, rule_paths - spec_paths

@@ -294,3 +294,102 @@ def test_singlehost_has_no_coordinator():
     names = [t["name"] for d in parsed for t in d["spec"]["templates"]]
     assert "gordo-coordinator-service" not in names
     assert "withSequence" not in docs
+
+
+def test_side_deployments_rendered_and_gated(config_file):
+    docs = _render(config_file)
+    tmpl_names = {t["name"] for t in docs[0]["spec"]["templates"]}
+    assert {
+        "gordo-influx", "gordo-influx-service",
+        "gordo-postgres", "gordo-postgres-service",
+        "gordo-grafana", "gordo-grafana-service",
+    } <= tmpl_names
+    dag = next(t for t in docs[0]["spec"]["templates"] if t["name"] == "do-all")
+    task_names = {t["name"] for t in dag["dag"]["tasks"]}
+    assert {"deploy-influx", "deploy-postgres", "deploy-grafana"} <= task_names
+    # manifests must themselves be valid k8s YAML
+    for t in docs[0]["spec"]["templates"]:
+        if "resource" in t and t["name"].startswith(
+            ("gordo-influx", "gordo-postgres", "gordo-grafana")
+        ):
+            manifest = yaml.safe_load(t["resource"]["manifest"])
+            assert manifest["kind"] in ("StatefulSet", "Deployment", "Service")
+
+    # in-cluster postgres becomes every machine's reporter sink
+    builder_tmpl = next(
+        t for t in docs[0]["spec"]["templates"] if t["name"] == "stage-config"
+    )
+    staged = builder_tmpl["script"]["source"]
+    assert "gordo-postgres-test-proj" in staged
+
+    # gates
+    off = _render(
+        config_file,
+        enable_influx=False,
+        enable_postgres=False,
+        enable_grafana=False,
+    )
+    off_names = {t["name"] for t in off[0]["spec"]["templates"]}
+    assert not any(n.startswith(("gordo-influx", "gordo-postgres", "gordo-grafana"))
+                   for n in off_names)
+
+    # an external postgres host suppresses the in-cluster deploy but keeps
+    # the reporter pointed at the external host
+    ext = _render(config_file, postgres_host="pg.example.com")
+    ext_names = {t["name"] for t in ext[0]["spec"]["templates"]}
+    assert "gordo-postgres" not in ext_names
+    staged_ext = next(
+        t for t in ext[0]["spec"]["templates"] if t["name"] == "stage-config"
+    )["script"]["source"]
+    assert "pg.example.com" in staged_ext
+
+
+def test_workflow_validator_catches_broken_docs(config_file):
+    from gordo_tpu.workflow.validate import (
+        WorkflowValidationError,
+        validate_workflow_docs,
+    )
+
+    content = generate_workflow_docs(
+        machine_config=config_file, project_name="test-proj"
+    )
+    validate_workflow_docs(content)  # rendered docs are valid
+
+    doc = yaml.safe_load(content.split("\n---\n")[0])
+
+    # undefined template reference in the DAG
+    bad = yaml.safe_load(content.split("\n---\n")[0])
+    dag = next(t for t in bad["spec"]["templates"] if "dag" in t)
+    dag["dag"]["tasks"][0]["template"] = "no-such-template"
+    with pytest.raises(WorkflowValidationError, match="undefined template"):
+        validate_workflow_docs(yaml.safe_dump(bad))
+
+    # dependency cycle
+    bad = yaml.safe_load(content.split("\n---\n")[0])
+    dag = next(t for t in bad["spec"]["templates"] if "dag" in t)
+    t0, t1 = dag["dag"]["tasks"][0], dag["dag"]["tasks"][1]
+    t0["dependencies"] = [t1["name"]]
+    t1["dependencies"] = [t0["name"]]
+    with pytest.raises(WorkflowValidationError, match="cycle"):
+        validate_workflow_docs(yaml.safe_dump(bad))
+
+    # invalid DNS-1123 template name
+    bad = yaml.safe_load(content.split("\n---\n")[0])
+    bad["spec"]["templates"][0]["name"] = "Not_A_Valid_Name"
+    with pytest.raises(WorkflowValidationError, match="DNS-1123"):
+        validate_workflow_docs(yaml.safe_dump(bad))
+
+    # unquoted numeric env value
+    bad = yaml.safe_load(content.split("\n---\n")[0])
+    for t in bad["spec"]["templates"]:
+        if "container" in t and t["container"].get("env"):
+            t["container"]["env"][0]["value"] = 42
+            break
+    with pytest.raises(WorkflowValidationError, match="must be a string"):
+        validate_workflow_docs(yaml.safe_dump(bad))
+
+    # missing entrypoint
+    bad = yaml.safe_load(content.split("\n---\n")[0])
+    del bad["spec"]["entrypoint"]
+    with pytest.raises(WorkflowValidationError, match="entrypoint"):
+        validate_workflow_docs(yaml.safe_dump(bad))
