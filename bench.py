@@ -447,11 +447,10 @@ def main():
             env = dict(os.environ)
             env["GORDO_TPU_BENCH_REEXEC"] = "1"
             env["JAX_PLATFORMS"] = "cpu"
-            env["PYTHONPATH"] = os.pathsep.join(
-                p
-                for p in env.get("PYTHONPATH", "").split(os.pathsep)
-                if p and "axon" not in p
-            )
+            # accelerator plugins ride in via PYTHONPATH site hooks; a clean
+            # interpreter needs none of it (bench.py inserts its own dir on
+            # sys.path at startup)
+            env["PYTHONPATH"] = ""
             os.execve(sys.executable, [sys.executable, __file__], env)
         jax.config.update("jax_platforms", "cpu")
         os.environ["XLA_FLAGS"] = (

@@ -54,12 +54,31 @@ def _client(ctx, **extra) -> Client:
     default=None,
     help="Forward prediction batches as parquet files under this directory",
 )
+@click.option(
+    "--influx-uri",
+    default=None,
+    envvar="GORDO_INFLUX_URI",
+    help="Forward prediction batches into InfluxDB at <host>:<port>/<db> "
+    "(the workflow's per-project influx side-deployment)",
+)
+@click.option(
+    "--influx-api-key",
+    default="",
+    envvar="GORDO_INFLUX_API_KEY",
+)
 @click.pass_context
-def predict(ctx, start, end, target, output_dir):
+def predict(ctx, start, end, target, output_dir, influx_uri, influx_api_key):
     """Predict the time range [START, END] for the target machines."""
-    forwarder = (
-        ForwardPredictionsToDisk(output_dir) if output_dir else None
-    )
+    forwarder = None
+    if influx_uri:
+        from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+
+        forwarder = ForwardPredictionsIntoInflux(
+            destination_influx_uri=influx_uri,
+            destination_influx_api_key=influx_api_key,
+        )
+    elif output_dir:
+        forwarder = ForwardPredictionsToDisk(output_dir)
     client = _client(ctx, prediction_forwarder=forwarder)
     results = client.predict(start, end, targets=list(target) or None)
     failed = False

@@ -3,10 +3,10 @@ Prediction forwarders: callables the client invokes per prediction batch.
 
 Reference parity: gordo-client's ``ForwardPredictionsIntoInflux`` (used by
 the workflow's client pods to push results into the per-project InfluxDB,
-argo-workflow.yml.template:1336-1345). Influx is gated on the driver being
-installed; ``ForwardPredictionsToDisk`` is the built-in always-available
-sink (parquet files per machine — the same columnar format the serving
-stack already speaks).
+argo-workflow.yml.template:1336-1345), reimplemented on the bare 1.x HTTP
+write API (line protocol) so no influx client library is needed;
+``ForwardPredictionsToDisk`` is the built-in local sink (parquet files per
+machine — the same columnar format the serving stack already speaks).
 """
 
 import abc
@@ -14,6 +14,7 @@ import logging
 import os
 from typing import Any, Optional
 
+import numpy as np
 import pandas as pd
 
 logger = logging.getLogger(__name__)
@@ -61,12 +62,26 @@ class ForwardPredictionsToDisk(PredictionForwarder):
         logger.info("Forwarded %d rows for %s -> %s", len(out), machine, path)
 
 
+def _lp_escape(value: str, *, is_measurement: bool = False) -> str:
+    """InfluxDB line-protocol escaping for measurements/tag values/field keys."""
+    out = str(value).replace("\\", "\\\\").replace(",", "\\,").replace(" ", "\\ ")
+    if not is_measurement:
+        out = out.replace("=", "\\=")
+    return out
+
+
 class ForwardPredictionsIntoInflux(PredictionForwarder):
     """
-    Write total anomaly scores and per-tag errors to InfluxDB.
+    Write prediction/anomaly blocks into InfluxDB over its 1.x HTTP write
+    API (line protocol) — no client library needed; pairs with the workflow's
+    per-project influx side-deployment and the dataset layer's
+    InfluxDataProvider, which reads the same database back.
 
-    Requires the ``influxdb`` package (not bundled); construction succeeds
-    (so configs parse) but forwarding raises if the driver is missing.
+    Each top-level block of the MultiIndex frame becomes a measurement
+    (``total-anomaly-scaled``, ``tag-anomaly-unscaled``, ...) tagged with the
+    machine name; sub-columns become fields. Non-numeric columns (the
+    'start'/'end' iso strings) are skipped — timestamps are the line's own
+    time component.
     """
 
     def __init__(
@@ -74,47 +89,116 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
         destination_influx_uri: str = "",
         destination_influx_api_key: str = "",
         destination_influx_recreate: bool = False,
+        session=None,
+        batch_lines: int = 5000,
     ):
-        self.uri = destination_influx_uri
+        # accepts both <host>:<port>/<db> (reference client convention) and
+        # scheme-prefixed uris
+        from gordo_tpu.util.utils import parse_service_uri
+
+        scheme, host, port, database = parse_service_uri(
+            destination_influx_uri, default_path="gordo"
+        )
+        self.base_url = f"{scheme or 'http'}://{host}:{port}"
+        self.database = database
         self.api_key = destination_influx_api_key
         self.recreate = destination_influx_recreate
-        self._client = None
+        self.batch_lines = batch_lines
+        self._session = session
+        self._prepared = False
 
-    def _influx_client(self):
-        if self._client is None:
-            try:
-                from influxdb import DataFrameClient
-            except ImportError as exc:
-                raise RuntimeError(
-                    "the 'influxdb' package is not installed; use "
-                    "ForwardPredictionsToDisk or install the driver"
-                ) from exc
-            # uri format: <host>:<port>/<db> (reference client convention)
-            host_port, _, database = self.uri.partition("/")
-            host, _, port = host_port.partition(":")
-            database = database or "gordo"
-            self._client = DataFrameClient(
-                host=host or "localhost",
-                port=int(port or 8086),
-                database=database,
+    @property
+    def session(self):
+        if self._session is None:
+            import requests
+
+            self._session = requests.Session()
+        return self._session
+
+    def _headers(self) -> dict:
+        return (
+            {"Authorization": self.api_key} if self.api_key else {}
+        )
+
+    def _prepare(self):
+        if self._prepared:
+            return
+        statements = (
+            [f'DROP DATABASE "{self.database}"'] if self.recreate else []
+        ) + [f'CREATE DATABASE "{self.database}"']
+        for q in statements:
+            resp = self.session.post(
+                f"{self.base_url}/query",
+                params={"q": q},
+                headers=self._headers(),
             )
-            if self.recreate:
-                self._client.drop_database(database)
-                self._client.create_database(database)
-        return self._client
+            status = getattr(resp, "status_code", 200)
+            if status >= 300:
+                raise IOError(
+                    f"InfluxDB statement {q!r} failed ({status}): "
+                    f"{getattr(resp, 'text', '')[:300]}"
+                )
+        self._prepared = True
+
+    def _write(self, lines) -> None:
+        resp = self.session.post(
+            f"{self.base_url}/write",
+            params={"db": self.database, "precision": "ns"},
+            data="\n".join(lines).encode(),
+            headers=self._headers(),
+        )
+        status = getattr(resp, "status_code", 204)
+        if status >= 300:
+            raise IOError(
+                f"InfluxDB write failed ({status}): "
+                f"{getattr(resp, 'text', '')[:300]}"
+            )
 
     def forward(
         self, predictions: pd.DataFrame, machine: str, metadata: dict
     ) -> None:
-        client = self._influx_client()
-        if isinstance(predictions.columns, pd.MultiIndex):
-            top_levels = predictions.columns.get_level_values(0).unique()
-            for level in top_levels:
-                block = predictions[level]
-                client.write_points(
-                    block, measurement=str(level), tags={"machine": machine}
-                )
+        self._prepare()
+        index = predictions.index
+        if isinstance(index, pd.DatetimeIndex):
+            # normalize to nanosecond epoch whatever the index's stored unit
+            times_ns = index.as_unit("ns").asi8
         else:
-            client.write_points(
-                predictions, measurement="prediction", tags={"machine": machine}
-            )
+            times_ns = pd.RangeIndex(len(predictions)).to_numpy()
+        machine_tag = _lp_escape(machine)
+
+        if isinstance(predictions.columns, pd.MultiIndex):
+            blocks = [
+                (str(level), predictions[level])
+                for level in predictions.columns.get_level_values(0).unique()
+            ]
+        else:
+            blocks = [("prediction", predictions)]
+
+        lines = []
+        for measurement, block in blocks:
+            if isinstance(block, pd.Series):
+                # a squeezed single-column block: the field is just "value"
+                block = block.to_frame(name="")
+            numeric = block.select_dtypes(include="number")
+            if numeric.shape[1] == 0:
+                continue  # start/end iso-string columns
+            meas = _lp_escape(measurement, is_measurement=True)
+            field_keys = [
+                _lp_escape(str(c) or "value") for c in numeric.columns
+            ]
+            values = numeric.to_numpy()
+            for i, t_ns in enumerate(times_ns):
+                fields = ",".join(
+                    f"{key}={float(val)}"
+                    for key, val in zip(field_keys, values[i])
+                    # NaN/inf are invalid line protocol and reject the batch
+                    if np.isfinite(val)
+                )
+                if not fields:
+                    continue
+                lines.append(f"{meas},machine={machine_tag} {fields} {int(t_ns)}")
+                if len(lines) >= self.batch_lines:
+                    self._write(lines)
+                    lines = []
+        if lines:
+            self._write(lines)

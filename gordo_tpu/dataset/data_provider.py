@@ -153,14 +153,16 @@ class InfluxDataProvider(GordoBaseDataProvider):
         **kwargs,
     ):
         if uri:
-            # "scheme://host:port/database" shorthand
-            from urllib.parse import urlparse
+            # "scheme://host:port/database" or the scheme-less
+            # "host:port/database" shorthand (same grammar as the client's
+            # influx forwarder)
+            from gordo_tpu.util.utils import parse_service_uri
 
-            parsed = urlparse(uri)
-            scheme = parsed.scheme or scheme
-            host = parsed.hostname or host
-            port = parsed.port or port
-            database = parsed.path.lstrip("/") or database
+            parsed_scheme, host, port, parsed_db = parse_service_uri(
+                uri, default_port=port
+            )
+            scheme = parsed_scheme or scheme
+            database = parsed_db or database
         self.measurement = measurement
         self.value_name = value_name
         self.tag_key = tag_key

@@ -28,3 +28,25 @@ def capture_args(method):
         return method(self, *args, **kwargs)
 
     return wrapper
+
+
+def parse_service_uri(uri, default_host="localhost", default_port=8086,
+                      default_path=""):
+    """
+    Parse a service address in either convention used across gordo configs:
+    ``scheme://host:port/path`` or the scheme-less ``host:port/path``
+    (the reference client's influx shorthand). Returns
+    ``(scheme, host, port, path)`` with '' scheme when none was given.
+    Raises ValueError with the offending uri on garbage ports.
+    """
+    scheme = ""
+    rest = uri or ""
+    if "://" in rest:
+        scheme, _, rest = rest.partition("://")
+    host_port, _, path = rest.partition("/")
+    host, _, port_str = host_port.partition(":")
+    try:
+        port = int(port_str) if port_str else default_port
+    except ValueError:
+        raise ValueError(f"Invalid port in service uri {uri!r}: {port_str!r}")
+    return scheme, host or default_host, port, path or default_path

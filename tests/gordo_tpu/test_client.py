@@ -164,3 +164,58 @@ def test_client_cli_metadata(app, gordo_project, gordo_name, monkeypatch, tmp_pa
     import json
 
     assert gordo_name in json.loads(out.read_text())
+
+
+def test_influx_forwarder_writes_line_protocol():
+    """ForwardPredictionsIntoInflux speaks the 1.x HTTP write API directly
+    (line protocol, no client library); stub session, no network."""
+    import numpy as np
+    import pandas as pd
+
+    from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+
+    posts = []
+
+    class StubResp:
+        status_code = 204
+        text = ""
+
+    class StubSession:
+        def post(self, url, params=None, data=None, headers=None):
+            posts.append((url, params, data))
+            return StubResp()
+
+    fwd = ForwardPredictionsIntoInflux(
+        destination_influx_uri="influx.example:8086/proj-db",
+        session=StubSession(),
+    )
+    idx = pd.date_range("2020-01-01", periods=3, freq="10min", tz="UTC")
+    frame = pd.DataFrame(
+        {
+            ("start", ""): [t.isoformat() for t in idx],
+            ("total-anomaly-scaled", ""): [0.1, np.nan, 0.3],
+            ("tag-anomaly-unscaled", "tag one"): [1.0, 2.0, 3.0],
+        },
+        index=idx,
+    )
+    frame.columns = pd.MultiIndex.from_tuples(frame.columns)
+    fwd.forward(frame, "machine a", {})
+
+    # database created, then one write
+    create_url, create_params, _ = posts[0]
+    assert create_url.endswith("/query")
+    assert create_params["q"] == 'CREATE DATABASE "proj-db"'
+    write_url, write_params, body = posts[-1]
+    assert write_url == "http://influx.example:8086/write"
+    assert write_params == {"db": "proj-db", "precision": "ns"}
+    lines = body.decode().splitlines()
+    # string block skipped; NaN row skipped for the scalar block
+    scaled = [l for l in lines if l.startswith("total-anomaly-scaled")]
+    unscaled = [l for l in lines if l.startswith("tag-anomaly-unscaled")]
+    assert len(scaled) == 2 and len(unscaled) == 3
+    assert not any(l.startswith("start") for l in lines)
+    # escaping: machine tag space, field-key space, ns timestamp
+    assert scaled[0] == (
+        f"total-anomaly-scaled,machine=machine\\ a value=0.1 {idx[0].value}"
+    )
+    assert "tag\\ one=1.0" in unscaled[0]
