@@ -133,6 +133,10 @@ N_WINDOWED = int(os.environ.get("BENCH_WINDOWED_MACHINES", "64"))
 WINDOWED_EPOCHS = int(os.environ.get("BENCH_WINDOWED_EPOCHS", "2"))
 WINDOWED_TAGS = 8
 LOOKBACK = 144
+# MXU-native precision for the windowed fleets (activations/matmuls only;
+# params, loss, fold predictions and thresholds remain float32). The torch
+# denominator stays float32 — its fastest CPU configuration.
+WINDOWED_DTYPE = os.environ.get("BENCH_WINDOWED_DTYPE", "bfloat16")
 
 _WINDOWED_FAMILIES = {
     "lstm_ae_144": (
@@ -173,6 +177,7 @@ def _windowed_machine_config(name: str, family: str) -> dict:
                                     "lookback_window": LOOKBACK,
                                     "epochs": WINDOWED_EPOCHS,
                                     "batch_size": 64,
+                                    "compute_dtype": WINDOWED_DTYPE,
                                 }
                             },
                         ]
@@ -313,6 +318,7 @@ def _bench_windowed() -> dict:
             "lookback": LOOKBACK,
             "n_tags": WINDOWED_TAGS,
             "epochs": WINDOWED_EPOCHS,
+            "compute_dtype": WINDOWED_DTYPE,
             "batched_wall_sec": round(wall, 2),
             "machines_per_min": round(N_WINDOWED / wall * 60.0, 2),
             "torch_sec_per_machine": round(torch_sec, 2),

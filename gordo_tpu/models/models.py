@@ -105,11 +105,15 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
     def extract_supported_fit_args(self, kwargs):
         return {k: kwargs[k] for k in self.supported_fit_args if k in kwargs}
 
+    # estimator-level kwargs consumed by build_spec itself, never factories
+    _spec_level_kwargs = ("compute_dtype",)
+
     def _factory_kwargs(self):
         out = {
             k: v
             for k, v in self.kwargs.items()
             if k not in self.supported_fit_args
+            and k not in self._spec_level_kwargs
         }
         return out
 
@@ -123,6 +127,21 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         return 0
 
     def build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
+        """Architecture for this estimator. Subclasses override
+        :meth:`_build_spec`; spec-level estimator kwargs (compute_dtype) are
+        applied here so they work uniformly across every family."""
+        spec = self._build_spec(n_features, n_features_out)
+        # TPU-native precision knob: matmuls/convs/scans run in this dtype
+        # (params and loss stay float32). ``compute_dtype: bfloat16``
+        # doubles MXU throughput on TPU.
+        compute_dtype = self.kwargs.get("compute_dtype")
+        if compute_dtype and compute_dtype != spec.compute_dtype:
+            import dataclasses
+
+            spec = dataclasses.replace(spec, compute_dtype=str(compute_dtype))
+        return spec
+
+    def _build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
         factory = register_model_builder.factories[self.factory_type][self.kind]
         kwargs = self._factory_kwargs()
         kwargs.setdefault("n_features", n_features)
@@ -419,7 +438,7 @@ class RawModelRegressor(AutoEncoder):
             )
         raise ValueError(f"Unsupported raw layer type: {name!r}")
 
-    def build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
+    def _build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
         if not all(k in self.kind for k in self._expected_keys):
             raise ValueError(
                 f"Expected spec to have keys: {self._expected_keys}, "

@@ -116,6 +116,10 @@ class ModelSpec:
     lookahead: int = 0
     optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
     loss: str = "mse"
+    # activation/matmul dtype inside apply_model ("float32" | "bfloat16");
+    # params, loss and outputs stay float32. bfloat16 is the MXU-native
+    # precision on TPU
+    compute_dtype: str = "float32"
 
     @property
     def is_recurrent(self) -> bool:

@@ -180,21 +180,25 @@ def _apply_lstm(layer: LSTMLayer, p, x):
 
     def step(carry, xt):
         h, c = carry
-        z = xt @ p["kernel"] + h @ p["recurrent_kernel"] + p["bias"]
+        # gate matmuls run at the input (compute) dtype; the recurrent cell
+        # state accumulates in float32 — bf16's 8-bit mantissa drifts badly
+        # over long scans in `c = f*c + i*g`
+        z = (xt @ p["kernel"] + h.astype(xt.dtype) @ p["recurrent_kernel"]
+             + p["bias"]).astype(jnp.float32)
         i = rec_act(z[:, :units])
         f = rec_act(z[:, units : 2 * units])
         g = act(z[:, 2 * units : 3 * units])
         o = rec_act(z[:, 3 * units :])
         c = f * c + i * g
         h = o * act(c)
-        return (h, c), h
+        return (h, c), h.astype(xt.dtype)
 
-    h0 = jnp.zeros((batch, units), x.dtype)
-    c0 = jnp.zeros((batch, units), x.dtype)
+    h0 = jnp.zeros((batch, units), jnp.float32)
+    c0 = jnp.zeros((batch, units), jnp.float32)
     (h, _), hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(x, 0, 1))
     if layer.return_sequences:
         return jnp.swapaxes(hs, 0, 1)
-    return h
+    return h.astype(x.dtype)
 
 
 def _layer_norm(x, scale, bias, eps=1e-6):
@@ -282,14 +286,28 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
     factories/feedforward_autoencoder.py:78-85 — l1(1e-4) on non-first encoder
     layers), normalized by batch size to keep loss scale batch-invariant.
     """
-    penalty = jnp.asarray(0.0, x.dtype)
+    compute_dtype = jnp.dtype(getattr(spec, "compute_dtype", "float32"))
     batch = x.shape[0]
     out = x
+    if out.dtype != compute_dtype:
+        out = out.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        # params stay float32 at rest (optimizer state, serialization);
+        # cast per forward so matmuls run at the compute dtype
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            params,
+        )
+    penalty = jnp.asarray(0.0, jnp.float32)
     for layer, p in zip(spec.layers, params):
         if isinstance(layer, DenseLayer):
             out = _apply_dense(layer, p, out)
             if layer.l1_activity > 0.0:
-                penalty = penalty + layer.l1_activity * jnp.sum(jnp.abs(out)) / batch
+                penalty = penalty + layer.l1_activity * jnp.sum(
+                    jnp.abs(out.astype(jnp.float32))
+                ) / batch
         elif isinstance(layer, LSTMLayer):
             out = _apply_lstm(layer, p, out)
         elif isinstance(layer, PositionalEncoding):
@@ -302,4 +320,6 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
             out = _apply_pool(layer, out)
         else:
             raise TypeError(f"Unknown layer spec: {layer!r}")
+    if out.dtype != jnp.float32:
+        out = out.astype(jnp.float32)
     return out, penalty
