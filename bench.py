@@ -428,9 +428,12 @@ def main():
     import jax
 
     # persistent XLA compilation cache: repeat runs skip the one-time
-    # program compile (~15s for the batched-builder program)
+    # program compile (~15s for the batched-builder program). Partitioned by
+    # platform — a remote-compiled TPU artifact must never be offered to a
+    # CPU-fallback run on a host with different machine features
+    platform_tag = os.environ.get("JAX_PLATFORMS") or "default"
     cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR", "/tmp/gordo_tpu_xla_cache"
+        "JAX_COMPILATION_CACHE_DIR", f"/tmp/gordo_tpu_xla_cache-{platform_tag}"
     )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -463,6 +466,18 @@ def main():
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8"
         ).strip()
+
+    # CPU (whether fallback or a CPU-only host) can't absorb the TPU-sized
+    # windowed fleets — bf16 is emulated there — so shrink the
+    # accelerator-bound sections unless explicitly configured; every metric
+    # still gets recorded, tagged with detail.platform
+    global N_WINDOWED, WINDOWED_DTYPE
+    if jax.default_backend() == "cpu":
+        if "BENCH_WINDOWED_MACHINES" not in os.environ:
+            N_WINDOWED = 8
+        if "BENCH_WINDOWED_DTYPE" not in os.environ:
+            WINDOWED_DTYPE = "float32"
+        os.environ.setdefault("BENCH_AB_ROUNDS", "5")
 
     from gordo_tpu.builder.build_model import ModelBuilder
     from gordo_tpu.machine import Machine

@@ -257,8 +257,102 @@ class InfluxDataProvider(GordoBaseDataProvider):
 
 
 @register_data_provider
+class ParquetFilesProvider(GordoBaseDataProvider):
+    """
+    Per-tag files on a local/mounted filesystem: the practical stand-in for
+    the reference's Azure Data Lake source (which is also, operationally, a
+    tree of per-sensor files behind a mount). Works with any storage that
+    presents as a path — NFS/PVC, gcsfuse, blobfuse.
+
+    Layout: ``<base_path>/<tag>.parquet`` (or ``.csv``), optionally nested
+    under the tag's asset: ``<base_path>/<asset>/<tag>.parquet``. Files need
+    a datetime index (parquet) or a first datetime column (csv) plus one
+    value column.
+    """
+
+    def __init__(self, base_path: str = ".", file_format: str = "parquet", **kwargs):
+        self.base_path = base_path
+        self.file_format = file_format
+        self._init_kwargs = dict(
+            base_path=base_path, file_format=file_format, **kwargs
+        )
+
+    def _tag_path(self, tag: SensorTag) -> Optional[str]:
+        import os
+
+        candidates = [
+            os.path.join(self.base_path, f"{tag.name}.{self.file_format}")
+        ]
+        if tag.asset:
+            candidates.insert(
+                0,
+                os.path.join(
+                    self.base_path, tag.asset, f"{tag.name}.{self.file_format}"
+                ),
+            )
+        for path in candidates:
+            if os.path.exists(path):
+                return path
+        return None
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return self._tag_path(tag) is not None
+
+    def _read(self, path: str) -> pd.Series:
+        if self.file_format == "parquet":
+            frame = pd.read_parquet(path)
+        elif self.file_format == "csv":
+            frame = pd.read_csv(path, index_col=0, parse_dates=True)
+        else:
+            raise ValueError(f"Unsupported file_format {self.file_format!r}")
+        if not isinstance(frame.index, pd.DatetimeIndex):
+            raise ValueError(f"{path}: needs a datetime index")
+        index = frame.index
+        if index.tz is None:
+            index = index.tz_localize("UTC")
+        return pd.Series(
+            frame.iloc[:, 0].to_numpy(np.float64), index=index
+        )
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        for tag in tag_list:
+            path = self._tag_path(tag)
+            if path is None:
+                raise FileNotFoundError(
+                    f"No {self.file_format} file for tag {tag.name!r} under "
+                    f"{self.base_path!r}"
+                )
+            series = self._read(path)
+
+            def _utc(ts):
+                stamp = pd.Timestamp(ts)
+                return (
+                    stamp.tz_localize("UTC") if stamp.tzinfo is None
+                    else stamp.tz_convert("UTC")
+                )
+
+            window = series.loc[
+                (series.index >= _utc(train_start_date))
+                & (series.index < _utc(train_end_date))
+            ]
+            if dry_run:
+                window = window.iloc[:1]
+            window.name = tag.name
+            yield window
+
+
+@register_data_provider
 class DataLakeProvider(GordoBaseDataProvider):
-    """Placeholder for the Azure Data Lake provider (interface parity only)."""
+    """Interface stub for the reference's Azure Data Lake source. The
+    credentialed Azure integration is out of scope here; point
+    :class:`ParquetFilesProvider` at a fuse-mounted container for the same
+    data through a path."""
 
     def __init__(self, storename: Optional[str] = None, interactive: bool = False, **kwargs):
         self.storename = storename
@@ -267,6 +361,7 @@ class DataLakeProvider(GordoBaseDataProvider):
 
     def load_series(self, train_start_date, train_end_date, tag_list, dry_run=False):
         raise NotImplementedError(
-            "DataLakeProvider requires Azure credentials; use RandomDataProvider "
-            "or a custom provider in this environment."
+            "DataLakeProvider requires Azure credentials; use "
+            "ParquetFilesProvider over a mounted container, InfluxDataProvider, "
+            "or RandomDataProvider."
         )
