@@ -150,9 +150,21 @@ class CrossModelBatcher:
     """Collects concurrent predict submissions for a short window and runs
     each same-shape group as one stacked device call."""
 
-    def __init__(self, window_ms: float = 2.0, max_batch: int = 64):
+    def __init__(
+        self,
+        window_ms: float = 2.0,
+        max_batch: int = 64,
+        timeout_s: Optional[float] = None,
+    ):
         self.window_s = window_ms / 1e3
         self.max_batch = max_batch
+        # generous default: the first batched predict of a (spec, shape)
+        # pays an XLA compile, which over a remote-device link can take
+        # tens of seconds; a timeout surfaces a wedged device as a 500
+        # instead of a request thread stuck forever
+        self.timeout_s = timeout_s or float(
+            os.environ.get("GORDO_TPU_BATCH_TIMEOUT_S", "300")
+        )
         self._q: "queue.Queue[_Item]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -170,8 +182,10 @@ class CrossModelBatcher:
         item = _Item(spec, params, X_pad, n_pad, n_keep)
         self._ensure_thread()
         self._q.put(item)
-        if not item.done.wait(timeout=120):
-            raise TimeoutError("batched predict timed out")
+        if not item.done.wait(timeout=self.timeout_s):
+            raise TimeoutError(
+                f"batched predict timed out after {self.timeout_s:.0f}s"
+            )
         if item.error is not None:
             raise item.error
         return item.result
