@@ -403,6 +403,121 @@ def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     }
 
 
+def _run_section(name: str) -> dict:
+    """Run one optional section as a subprocess with a wall-clock timeout.
+
+    The child re-enters this file with ``--section NAME`` and prints
+    ``{"platform": ..., "result": ...}`` on its last stdout line; the
+    platform is the child's own resolved backend, so a child that fell back
+    to CPU (tunnel died between sections) can't silently mix CPU numbers
+    into a TPU run. Returns that envelope, or ``{"error": ...}``.
+    """
+    import subprocess
+
+    timeout = int(
+        os.environ.get(
+            f"BENCH_SECTION_TIMEOUT_{name.upper()}",
+            os.environ.get("BENCH_SECTION_TIMEOUT", "2400"),
+        )
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            capture_output=True,
+            timeout=timeout,
+            text=True,
+        )
+    except subprocess.TimeoutExpired as exc:
+        for stream in (exc.stderr, exc.stdout):
+            if stream:
+                text = stream.decode(errors="replace") if isinstance(
+                    stream, bytes
+                ) else stream
+                sys.stderr.write(text[-2000:])
+        return {"error": f"section {name} hung past {timeout}s (device wedge?)"}
+    sys.stderr.write(proc.stderr[-2000:])
+    if proc.returncode != 0:
+        return {"error": f"section {name} exit {proc.returncode}: "
+                         + proc.stderr.strip()[-300:]}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"error": f"section {name} unparseable output: "
+                         + proc.stdout.strip()[-300:]}
+
+
+def _setup_backend(argv) -> None:
+    """Shared preamble for main() and section children: persistent compile
+    cache, backend liveness probe with clean-env CPU re-exec when the
+    accelerator tunnel is wedged, and CPU-scale shrinking of the
+    accelerator-bound sections.
+
+    Persistent cache is partitioned by platform — a remote-compiled TPU
+    artifact must never be offered to a CPU-fallback run on a host with
+    different machine features.
+    """
+    import jax
+
+    platform_tag = os.environ.get("JAX_PLATFORMS") or "default"
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", f"/tmp/gordo_tpu_xla_cache-{platform_tag}"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    probe_timeout = int(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "180"))
+    if not _default_backend_alive(probe_timeout):
+        print(
+            f"# default backend unreachable within {probe_timeout}s; "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
+        if os.environ.get("GORDO_TPU_BENCH_REEXEC") != "1":
+            # a wedged accelerator plugin blocks even the CPU platform
+            # in-process (plugin init runs at first device op), so the CPU
+            # fallback must be a clean interpreter without the plugin's
+            # site hook on PYTHONPATH (bench.py re-inserts its own dir on
+            # sys.path at startup)
+            env = dict(os.environ)
+            env["GORDO_TPU_BENCH_REEXEC"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = ""
+            os.execve(sys.executable, [sys.executable, __file__, *argv[1:]], env)
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    # CPU (whether fallback or a CPU-only host) can't absorb the TPU-sized
+    # windowed fleets — bf16 is emulated there — so shrink the
+    # accelerator-bound sections unless explicitly configured; every metric
+    # still gets recorded, tagged with its platform
+    global N_WINDOWED, WINDOWED_DTYPE
+    if jax.default_backend() == "cpu":
+        if "BENCH_WINDOWED_MACHINES" not in os.environ:
+            N_WINDOWED = 8
+        if "BENCH_WINDOWED_DTYPE" not in os.environ:
+            WINDOWED_DTYPE = "float32"
+        os.environ.setdefault("BENCH_AB_ROUNDS", "5")
+
+
+def _section_child(name: str) -> None:
+    """Child entrypoint: resolve a backend the same way main() does, run the
+    section, print its ``{"platform", "result"}`` envelope as the last
+    stdout line."""
+    import jax
+
+    _setup_backend(sys.argv)
+    sections = {"windowed": _bench_windowed, "batch_ab": _bench_batch_ab}
+    result = sections[name]()
+    envelope = {"platform": jax.devices()[0].platform, "result": result}
+    print(json.dumps(envelope))
+
+
 def _default_backend_alive(timeout_sec: int) -> bool:
     """
     Probe the default JAX backend in a subprocess with a hard timeout.
@@ -428,57 +543,7 @@ def _default_backend_alive(timeout_sec: int) -> bool:
 def main():
     import jax
 
-    # persistent XLA compilation cache: repeat runs skip the one-time
-    # program compile (~15s for the batched-builder program). Partitioned by
-    # platform — a remote-compiled TPU artifact must never be offered to a
-    # CPU-fallback run on a host with different machine features
-    platform_tag = os.environ.get("JAX_PLATFORMS") or "default"
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR", f"/tmp/gordo_tpu_xla_cache-{platform_tag}"
-    )
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
-
-    probe_timeout = int(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "180"))
-    if not _default_backend_alive(probe_timeout):
-        print(
-            f"# default backend unreachable within {probe_timeout}s; "
-            "falling back to CPU",
-            file=sys.stderr,
-        )
-        if os.environ.get("GORDO_TPU_BENCH_REEXEC") != "1":
-            # a wedged accelerator plugin blocks even the CPU platform
-            # in-process (plugin init runs at first device op), so the CPU
-            # fallback must be a clean interpreter without the plugin's
-            # site hook on PYTHONPATH
-            env = dict(os.environ)
-            env["GORDO_TPU_BENCH_REEXEC"] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
-            # accelerator plugins ride in via PYTHONPATH site hooks; a clean
-            # interpreter needs none of it (bench.py inserts its own dir on
-            # sys.path at startup)
-            env["PYTHONPATH"] = ""
-            os.execve(sys.executable, [sys.executable, __file__], env)
-        jax.config.update("jax_platforms", "cpu")
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
-
-    # CPU (whether fallback or a CPU-only host) can't absorb the TPU-sized
-    # windowed fleets — bf16 is emulated there — so shrink the
-    # accelerator-bound sections unless explicitly configured; every metric
-    # still gets recorded, tagged with detail.platform
-    global N_WINDOWED, WINDOWED_DTYPE
-    if jax.default_backend() == "cpu":
-        if "BENCH_WINDOWED_MACHINES" not in os.environ:
-            N_WINDOWED = 8
-        if "BENCH_WINDOWED_DTYPE" not in os.environ:
-            WINDOWED_DTYPE = "float32"
-        os.environ.setdefault("BENCH_AB_ROUNDS", "5")
+    _setup_backend(sys.argv)
 
     from gordo_tpu.builder.build_model import ModelBuilder
     from gordo_tpu.machine import Machine
@@ -517,26 +582,18 @@ def main():
     # ---- serving: reference harness shape on the anomaly endpoint
     serving = _bench_serving(results[0])
 
-    # ---- windowed fleets (LSTM/Transformer, lookback 144) + torch CPU
-    # A failed late section must not discard the headline numbers above —
-    # the TPU tunnel here can wedge mid-run (see _default_backend_alive) —
-    # so each optional section degrades to a recorded error instead.
+    # ---- optional sections, isolated in subprocesses: the TPU tunnel here
+    # can wedge mid-run (a device call that HANGS, not raises — see
+    # _default_backend_alive), and a hang inside a late section must not
+    # block the headline numbers already measured above. Each section runs
+    # as `bench.py --section NAME` with a hard wall-clock timeout; a hang or
+    # crash degrades to a recorded error entry.
     windowed = {}
     if os.environ.get("BENCH_WINDOWED", "1") != "0":
-        try:
-            windowed = _bench_windowed()
-        except Exception as exc:  # noqa: BLE001 — record, don't lose the run
-            windowed = {"error": repr(exc)[:300]}
-            print(f"# windowed section failed: {exc!r}", file=sys.stderr)
-
-    # ---- cross-model batching A/B (recorded, per round-2 verdict)
+        windowed = _run_section("windowed")
     batch_ab = {}
     if os.environ.get("BENCH_BATCH_AB", "1") != "0":
-        try:
-            batch_ab = _bench_batch_ab()
-        except Exception as exc:  # noqa: BLE001
-            batch_ab = {"error": repr(exc)[:300]}
-            print(f"# batch A/B section failed: {exc!r}", file=sys.stderr)
+        batch_ab = _run_section("batch_ab")
 
     print(
         json.dumps(
@@ -574,4 +631,7 @@ def main():
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--section":
+        _section_child(sys.argv[2])
+    else:
+        main()

@@ -162,9 +162,10 @@ class CrossModelBatcher:
         # pays an XLA compile, which over a remote-device link can take
         # tens of seconds; a timeout surfaces a wedged device as a 500
         # instead of a request thread stuck forever
-        self.timeout_s = timeout_s or float(
-            os.environ.get("GORDO_TPU_BATCH_TIMEOUT_S", "300")
-        )
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("GORDO_TPU_BATCH_TIMEOUT_S", "300"))
+        # <=0 means wait without limit
+        self.timeout_s = timeout_s if timeout_s > 0 else None
         self._q: "queue.Queue[_Item]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -185,7 +186,7 @@ class CrossModelBatcher:
         if not item.done.wait(timeout=self.timeout_s):
             raise TimeoutError(
                 f"batched predict timed out after {self.timeout_s:.0f}s"
-            )
+            )  # wait() only returns False with a finite timeout
         if item.error is not None:
             raise item.error
         return item.result
