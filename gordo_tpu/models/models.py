@@ -106,7 +106,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         return {k: kwargs[k] for k in self.supported_fit_args if k in kwargs}
 
     # estimator-level kwargs consumed by build_spec itself, never factories
-    _spec_level_kwargs = ("compute_dtype",)
+    _spec_level_kwargs = ("compute_dtype", "tensor_parallel")
 
     def _factory_kwargs(self):
         out = {
@@ -139,6 +139,17 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             import dataclasses
 
             spec = dataclasses.replace(spec, compute_dtype=str(compute_dtype))
+        # model-axis sharding: validate divisibility and pin attention to the
+        # GSPMD-partitionable impl up front, at spec-build time
+        tensor_parallel = int(self.kwargs.get("tensor_parallel", 0) or 0)
+        if tensor_parallel > 1:
+            import dataclasses
+
+            from gordo_tpu.parallel.tensor_parallel import prepare_tp_spec
+
+            spec = prepare_tp_spec(
+                dataclasses.replace(spec, tensor_parallel=tensor_parallel)
+            )
         return spec
 
     def _build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
@@ -208,6 +219,12 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         if not hasattr(self, "params_"):
             raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
         X = self._as_2d_array(X)
+        from gordo_tpu.parallel.tensor_parallel import maybe_reshard_params, tp_degree
+
+        if tp_degree(self.spec_) > 1:
+            # artifact-loaded params are host numpy; re-establish the model-
+            # mesh sharding before the first jitted predict
+            self.params_ = maybe_reshard_params(self.spec_, self.params_)
         # serving: concurrent predicts across models fuse into one device
         # call when the cross-model batcher is enabled (server/batcher.py)
         from gordo_tpu.server.batcher import maybe_submit

@@ -120,6 +120,10 @@ class ModelSpec:
     # params, loss and outputs stay float32. bfloat16 is the MXU-native
     # precision on TPU
     compute_dtype: str = "float32"
+    # shard this model's Transformer weights over an N-chip `model` mesh
+    # axis (parallel/tensor_parallel.py). 0/1 = single-device params. Like
+    # ring attention, TP models keep off the vmap-over-machines/models paths
+    tensor_parallel: int = 0
 
     @property
     def is_recurrent(self) -> bool:

@@ -343,6 +343,12 @@ def fit_arrays(
             f"{spec.lookback_window} lookahead={spec.lookahead}"
         )
     batch_size = min(batch_size, max(n_samples, 1))
+    from gordo_tpu.parallel.tensor_parallel import shard_params_tp, tp_degree
+
+    if tp_degree(spec) > 1:
+        # commit the weights to the `model` mesh; every jitted step below
+        # then runs SPMD with XLA-inserted collectives, unchanged
+        params = shard_params_tp(spec, params)
     epoch_fn = _build_epoch_fn(spec, n_samples, batch_size, shuffle)
 
     opt = make_optimizer(spec.optimizer)

@@ -15,6 +15,7 @@ from . import distributed
 from .mesh import default_mesh, machines_sharding
 from .batch_trainer import BatchedModelBuilder
 from .ring_attention import make_ring_attention, sequence_sharding
+from .tensor_parallel import prepare_tp_spec, shard_params_tp, tp_mesh
 
 __all__ = [
     "default_mesh",
@@ -22,4 +23,7 @@ __all__ = [
     "BatchedModelBuilder",
     "make_ring_attention",
     "sequence_sharding",
+    "prepare_tp_spec",
+    "shard_params_tp",
+    "tp_mesh",
 ]

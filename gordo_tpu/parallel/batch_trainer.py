@@ -227,6 +227,12 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         # ring attention is shard_map over the whole mesh — it cannot run
         # under this builder's vmap-over-machines; serial path owns it
         return None
+    from gordo_tpu.parallel.tensor_parallel import tp_degree
+
+    if tp_degree(spec) > 1:
+        # model-axis-sharded params claim the mesh for ONE machine; the
+        # serial path owns TP machines (parallel/tensor_parallel.py)
+        return None
 
     return _Plan(
         machine=machine,

@@ -1,0 +1,187 @@
+"""
+Tensor parallelism for Transformer machines: shard the model, not the data.
+
+The reference's only scaling axis is more pods (SURVEY §2 parallelism
+accounting: no TP/PP/SP of any kind; single-model Keras ``fit``,
+gordo/machine/model/models.py:284). gordo_tpu already scales *out* over
+machines (fleet trainer) and over the sequence (ring attention); this module
+adds the third axis — sharding one model's weights over a ``model`` mesh
+axis for architectures too large for a single chip's HBM.
+
+TPU-first design: no manual collectives. Parameters get ``NamedSharding``
+annotations in the Megatron pattern — attention QKV and the first FFN matmul
+column-parallel (output dim sharded, which splits attention *heads* across
+chips), the output projections row-parallel (input dim sharded) — and
+GSPMD/XLA propagates the shardings through the jitted forward/backward,
+inserting the two all-reduces per block over ICI. The same ``apply_model`` /
+epoch functions run unmodified; sharding is purely a placement concern
+(jax.device_put of the params pytree), so the math is bit-for-bit the
+single-device program's up to reduction order.
+
+Interplay with the other axes:
+- The fleet trainer vmaps over machines and the serving batcher vmaps over
+  models; a sharded-parameter model cannot ride either, so TP specs are
+  guarded onto the serial/direct paths (same policy as ring attention).
+- Attention must be the einsum (``xla``) implementation under TP: the Pallas
+  flash kernel is a single-device program that GSPMD cannot partition over
+  the head axis. ``prepare_tp_spec`` pins ``auto`` blocks to ``xla`` and
+  rejects explicit ``flash``/``ring``.
+"""
+
+import functools
+from dataclasses import replace
+from typing import Any, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gordo_tpu.models.spec import ModelSpec, TransformerBlock
+
+AXIS = "model"
+
+
+def tp_degree(spec: Any) -> int:
+    """The spec's tensor-parallel shard count (0/1 = off). Tolerates specs
+    unpickled from artifacts predating the field."""
+    return int(getattr(spec, "tensor_parallel", 0) or 0)
+
+
+def prepare_tp_spec(spec: ModelSpec) -> ModelSpec:
+    """Validate a TP spec and pin its attention to the partitionable impl.
+
+    Raises ``ValueError`` when the architecture cannot shard evenly or an
+    un-partitionable attention implementation was requested explicitly.
+    """
+    tp = tp_degree(spec)
+    if tp <= 1:
+        return spec
+    blocks = [l for l in spec.layers if isinstance(l, TransformerBlock)]
+    if not blocks:
+        raise ValueError(
+            f"tensor_parallel={tp} requires TransformerBlock layers; "
+            f"got {[type(l).__name__ for l in spec.layers]}"
+        )
+    layers = []
+    for layer in spec.layers:
+        if not isinstance(layer, TransformerBlock):
+            layers.append(layer)
+            continue
+        for dim_name, value in (
+            ("num_heads", layer.num_heads),
+            ("d_model", layer.d_model),
+            ("ff_dim", layer.ff_dim),
+        ):
+            if value % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} needs {dim_name} divisible by the "
+                    f"shard count, got {dim_name}={value}"
+                )
+        if layer.attention_impl in ("flash", "ring"):
+            raise ValueError(
+                f"attention={layer.attention_impl!r} cannot run tensor-"
+                f"parallel (single-device kernel / whole-mesh shard_map); "
+                f"use attention='xla' (or 'auto') with tensor_parallel"
+            )
+        if layer.attention_impl != "xla":
+            layer = replace(layer, attention_impl="xla")
+        layers.append(layer)
+    return replace(spec, layers=tuple(layers))
+
+
+@functools.lru_cache(maxsize=8)
+def tp_mesh(n_shards: int) -> Mesh:
+    """A 1-D ``model`` mesh over the first ``n_shards`` *addressable*
+    devices. Local by design: in a multiprocess fleet a TP machine is owned
+    by one process (serial fallback), whose single-process ``device_put``
+    could not execute collectively over other hosts' chips."""
+    devices = jax.local_devices()
+    if n_shards > len(devices):
+        raise ValueError(
+            f"tensor_parallel={n_shards} but only {len(devices)} addressable "
+            f"device(s) ({devices[0].platform}); multi-chip TP needs a mesh "
+            f"of at least that many chips"
+        )
+    return Mesh(devices[:n_shards], (AXIS,))
+
+
+def tp_shardings(spec: ModelSpec, params, mesh: Mesh):
+    """Per-leaf shardings for a params pytree, Megatron-style.
+
+    Column-parallel (output dim sharded): ``wq/wk/wv`` (this splits heads —
+    head h lives wholly on chip h*tp//heads) and ``w_ff1``, with their
+    biases sharded the same way. Row-parallel (input dim sharded):
+    ``wo`` and ``w_ff2`` — their matmuls contract over the sharded dim, so
+    GSPMD emits one all-reduce each per block. Everything else (LayerNorm,
+    non-transformer layers) replicates.
+    """
+    repl = NamedSharding(mesh, P())
+    col = NamedSharding(mesh, P(None, AXIS))
+    row = NamedSharding(mesh, P(AXIS, None))
+    vec = NamedSharding(mesh, P(AXIS))
+    shardings = jax.tree_util.tree_map(lambda _: repl, params)
+    for i, layer in enumerate(spec.layers):
+        if not isinstance(layer, TransformerBlock):
+            continue
+        shardings[i] = {
+            "ln1_scale": repl,
+            "ln1_bias": repl,
+            "wq": col,
+            "wk": col,
+            "wv": col,
+            "bq": vec,
+            "bk": vec,
+            "bv": vec,
+            "wo": row,
+            "bo": repl,
+            "ln2_scale": repl,
+            "ln2_bias": repl,
+            "w_ff1": col,
+            "b_ff1": vec,
+            "w_ff2": row,
+            "b_ff2": repl,
+        }
+    return shardings
+
+
+def shard_params_tp(
+    spec: ModelSpec, params, mesh: Optional[Mesh] = None, strict: bool = True
+):
+    """Place a params pytree onto the TP mesh (no-op when TP is off).
+
+    After this, every jitted function consuming the params — epoch steps,
+    evaluation, prediction — runs SPMD over the mesh with XLA-inserted
+    collectives; callers need no code changes.
+
+    ``strict=False`` degrades to unsharded params when the host has fewer
+    chips than the spec's shard count — a TP-trained artifact then serves
+    single-device (if it fits), mirroring ring attention's 1-device
+    fallback; training keeps ``strict=True`` because TP is a capacity
+    claim there.
+    """
+    tp = tp_degree(spec)
+    if tp <= 1:
+        return params
+    try:
+        mesh = mesh or tp_mesh(tp)
+    except ValueError:
+        if strict:
+            raise
+        return params
+    return jax.device_put(params, tp_shardings(spec, params, mesh))
+
+
+def maybe_reshard_params(spec: ModelSpec, params):
+    """Re-establish TP sharding on host-resident params (artifact load).
+
+    Fitted params come back sharded from :func:`shard_params_tp`; params
+    unpickled from an artifact are plain numpy and would otherwise be
+    placed whole on one device by the first jitted predict — defeating the
+    capacity purpose of TP. Already-device-resident trees pass through
+    untouched.
+    """
+    if tp_degree(spec) <= 1:
+        return params
+    leaves = jax.tree_util.tree_leaves(params)
+    if leaves and all(isinstance(l, jax.Array) for l in leaves):
+        return params
+    return shard_params_tp(spec, params, strict=False)
