@@ -19,6 +19,7 @@ Timeseries window semantics (lookback/lookahead) match the reference's
 lookback L and lookahead a outputs len(X) - L + 1 - a rows.
 """
 
+import dataclasses
 import logging
 from copy import copy
 from pprint import pformat
@@ -106,7 +107,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         return {k: kwargs[k] for k in self.supported_fit_args if k in kwargs}
 
     # estimator-level kwargs consumed by build_spec itself, never factories
-    _spec_level_kwargs = ("compute_dtype", "tensor_parallel")
+    _spec_level_kwargs = ("compute_dtype", "tensor_parallel", "remat")
 
     def _factory_kwargs(self):
         out = {
@@ -136,15 +137,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         # doubles MXU throughput on TPU.
         compute_dtype = self.kwargs.get("compute_dtype")
         if compute_dtype and compute_dtype != spec.compute_dtype:
-            import dataclasses
-
             spec = dataclasses.replace(spec, compute_dtype=str(compute_dtype))
+        if self.kwargs.get("remat"):
+            spec = dataclasses.replace(spec, remat=True)
         # model-axis sharding: validate divisibility and pin attention to the
         # GSPMD-partitionable impl up front, at spec-build time
         tensor_parallel = int(self.kwargs.get("tensor_parallel", 0) or 0)
         if tensor_parallel > 1:
-            import dataclasses
-
             from gordo_tpu.parallel.tensor_parallel import prepare_tp_spec
 
             spec = prepare_tp_spec(

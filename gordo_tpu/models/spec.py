@@ -124,6 +124,10 @@ class ModelSpec:
     # axis (parallel/tensor_parallel.py). 0/1 = single-device params. Like
     # ring attention, TP models keep off the vmap-over-machines/models paths
     tensor_parallel: int = 0
+    # rematerialize sequence layers (LSTM/Transformer/TCN) on the backward
+    # pass (jax.checkpoint): activations are recomputed instead of stored,
+    # trading FLOPs for HBM — the standard long-window training lever on TPU
+    remat: bool = False
 
     @property
     def is_recurrent(self) -> bool:

@@ -10,6 +10,7 @@ Everything is shape-static and vmap-safe: parameters are dicts of jnp arrays,
 and ``apply_model`` is a pure function of (spec, params, x).
 """
 
+import functools
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -300,6 +301,17 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
             else a,
             params,
         )
+    # remat: recompute sequence-layer activations on the backward pass
+    # instead of storing them — O(layers) fewer (B, T, D) live buffers, the
+    # HBM-for-FLOPs trade for long lookback windows. Dense/PE/Pool layers
+    # are cheap and stay stored.
+    remat = bool(getattr(spec, "remat", False))
+
+    def _seq_layer(fn, layer, p, x):
+        if remat:
+            return jax.checkpoint(functools.partial(fn, layer))(p, x)
+        return fn(layer, p, x)
+
     penalty = jnp.asarray(0.0, jnp.float32)
     for layer, p in zip(spec.layers, params):
         if isinstance(layer, DenseLayer):
@@ -309,13 +321,13 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
                     jnp.abs(out.astype(jnp.float32))
                 ) / batch
         elif isinstance(layer, LSTMLayer):
-            out = _apply_lstm(layer, p, out)
+            out = _seq_layer(_apply_lstm, layer, p, out)
         elif isinstance(layer, PositionalEncoding):
             out = _apply_positional_encoding(layer, out)
         elif isinstance(layer, TransformerBlock):
-            out = _apply_transformer_block(layer, p, out)
+            out = _seq_layer(_apply_transformer_block, layer, p, out)
         elif isinstance(layer, TCNBlock):
-            out = _apply_tcn_block(layer, p, out)
+            out = _seq_layer(_apply_tcn_block, layer, p, out)
         elif isinstance(layer, PoolLayer):
             out = _apply_pool(layer, out)
         else:

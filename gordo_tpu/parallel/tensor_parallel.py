@@ -29,6 +29,7 @@ Interplay with the other axes:
 """
 
 import functools
+import logging
 from dataclasses import replace
 from typing import Any, Optional, Sequence
 
@@ -36,6 +37,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gordo_tpu.models.spec import ModelSpec, TransformerBlock
+
+logger = logging.getLogger(__name__)
 
 AXIS = "model"
 
@@ -163,9 +166,12 @@ def shard_params_tp(
         return params
     try:
         mesh = mesh or tp_mesh(tp)
-    except ValueError:
+    except ValueError as exc:
         if strict:
             raise
+        logger.warning(
+            "tensor_parallel=%d model degrading to unsharded params: %s", tp, exc
+        )
         return params
     return jax.device_put(params, tp_shardings(spec, params, mesh))
 

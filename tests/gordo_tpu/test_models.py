@@ -277,3 +277,61 @@ def test_bfloat16_lstm_accuracy_and_raw_regressor():
     raw.fit(X, X)
     assert raw.spec_.compute_dtype == "bfloat16"
     assert np.all(np.isfinite(raw.predict(X)))
+
+
+def test_remat_is_numerically_identity():
+    """remat=True recomputes activations on the backward pass — same math,
+    same trained weights; only the memory/FLOPs trade changes."""
+    from gordo_tpu.models import models
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(160, 4).astype(np.float32)
+    kwargs = dict(
+        kind="transformer_model", lookback_window=16, d_model=16,
+        num_heads=2, ff_dim=32, num_blocks=1, epochs=2, batch_size=32,
+    )
+    np.random.seed(42)
+    plain = models.TransformerAutoEncoder(**kwargs)
+    plain.fit(X, X)
+    np.random.seed(42)
+    remat = models.TransformerAutoEncoder(remat=True, **kwargs)
+    remat.fit(X, X)
+    assert remat.spec_.remat and not plain.spec_.remat
+    np.testing.assert_allclose(
+        plain.predict(X), remat.predict(X), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        plain.history["loss"], remat.history["loss"], rtol=1e-5
+    )
+
+
+def test_remat_grad_contains_checkpoint():
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_tpu.ops.nn import apply_model, init_model_params
+
+    spec = LSTMAutoEncoder(
+        kind="lstm_symmetric", dims=[8], funcs=["tanh"], lookback_window=8,
+        remat=True,
+    ).build_spec(4, 4)
+    assert spec.remat
+    params = init_model_params(jax.random.PRNGKey(0), spec)
+    x = jnp.zeros((4, 8, 4), jnp.float32)
+
+    def loss(p):
+        out, _ = apply_model(spec, p, x)
+        return jnp.sum(out ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+    assert "remat" in str(jaxpr)
+
+
+def test_remat_roundtrips_through_definition():
+    from gordo_tpu.serializer import from_definition, into_definition
+
+    d = {"gordo_tpu.models.models.TransformerAutoEncoder": {
+        "kind": "transformer_model", "lookback_window": 16, "remat": True}}
+    model = from_definition(d)
+    back = into_definition(model)
+    assert back["gordo_tpu.models.models.TransformerAutoEncoder"]["remat"] is True
