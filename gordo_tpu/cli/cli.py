@@ -243,6 +243,14 @@ def build(
     envvar="GORDO_TPU_PROCESS_ID",
     help="This host's rank in the multi-host world",
 )
+@click.option(
+    "--model-register-dir",
+    default=None,
+    envvar="MODEL_REGISTER_DIR",
+    help="Content-hash registry dir: machines are checkpointed as soon as "
+    "their chunk finishes and an interrupted fleet build resumes from "
+    "cache instead of retraining",
+)
 def batch_build(
     config_file: str,
     output_dir: str,
@@ -252,6 +260,7 @@ def batch_build(
     coordinator_address: str,
     num_processes: int,
     process_id: int,
+    model_register_dir: str,
 ):
     """
     Train EVERY machine in a config in one SPMD program on the device mesh
@@ -278,15 +287,20 @@ def batch_build(
             )
         selected = [by_name[name] for name in sorted(wanted)]
     builder = BatchedModelBuilder(
-        selected, serial_fallback=not no_serial_fallback
+        selected,
+        serial_fallback=not no_serial_fallback,
+        output_dir=output_dir,
+        model_register_dir=model_register_dir,
     )
+    # the builder persists every machine as soon as its chunk finishes
+    # (checkpoint/resume); reporting stays here, after the fleet completes
     results = builder.build()
     for model, machine_out in results:
-        model_dir = os.path.join(output_dir, machine_out.name)
-        os.makedirs(model_dir, exist_ok=True)
-        serializer.dump(model, model_dir, metadata=machine_out.to_dict())
         machine_out.report()
-        click.echo(f"built: {machine_out.name} -> {model_dir}")
+        click.echo(
+            f"built: {machine_out.name} -> "
+            f"{os.path.join(output_dir, machine_out.name)}"
+        )
     return 0
 
 

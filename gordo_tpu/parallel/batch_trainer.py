@@ -470,6 +470,9 @@ class BatchedModelBuilder:
         mesh=None,
         serial_fallback: bool = True,
         chunk_size: Optional[int] = None,
+        output_dir: Optional[str] = None,
+        model_register_dir: Optional[str] = None,
+        replace_cache: bool = False,
     ):
         """
         ``chunk_size``: machines per compiled program. Large buckets are cut
@@ -481,6 +484,15 @@ class BatchedModelBuilder:
         $GORDO_TPU_CHUNK_MACHINES, else 256 (measured sweet spot on one
         v5e chip for the 4-tag hourglass workload: big enough to amortize
         dispatch, small enough to overlap transfers with compute).
+
+        ``output_dir``/``model_register_dir``: checkpoint/resume for fleet
+        builds. With both set, every machine is persisted (serializer.dump
+        into ``{output_dir}/{name}``) and content-hash-registered AS SOON as
+        its chunk finishes — a killed 10k-machine build resumes from the
+        last chunk, with already-built machines loaded from cache instead of
+        retrained (the fleet-scale form of the reference's whole-model cache,
+        gordo/builder/build_model.py:92-167). ``replace_cache`` forces
+        retraining, as in the serial builder.
         """
         self.machines = machines
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -488,6 +500,9 @@ class BatchedModelBuilder:
         if chunk_size is None:
             chunk_size = int(os.environ.get("GORDO_TPU_CHUNK_MACHINES", "256"))
         self.chunk_size = max(1, chunk_size)
+        self.output_dir = output_dir
+        self.model_register_dir = model_register_dir
+        self.replace_cache = replace_cache
 
     # -------------------------------------------------------------- data
     def _load_data(self, plan: _Plan):
@@ -520,12 +535,89 @@ class BatchedModelBuilder:
         with maybe_profile("batched-build"):
             return self._build_all(distributed)
 
+    def _machine_output_dir(self, name: str) -> Optional[str]:
+        if not self.output_dir:
+            return None
+        return os.path.join(self.output_dir, name)
+
+    def _cached_path(self, machine: Machine) -> Optional[str]:
+        """Registry lookup only (no unpickle); handles replace_cache."""
+        builder = ModelBuilder(machine)
+        if self.replace_cache:
+            from gordo_tpu.util import disk_registry
+
+            disk_registry.delete_value(self.model_register_dir, builder.cache_key)
+            return None
+        return builder.check_cache(self.model_register_dir)
+
+    def _persist(self, machine: Machine, model, machine_out: Machine) -> None:
+        """Dump + register one machine the moment it is assembled, so an
+        interrupted fleet build resumes instead of restarting."""
+        model_dir = self._machine_output_dir(machine_out.name)
+        if model_dir is None:
+            return
+        os.makedirs(model_dir, exist_ok=True)
+        serializer.dump(model, model_dir, metadata=machine_out.to_dict())
+        if self.model_register_dir:
+            from gordo_tpu.util import disk_registry
+
+            disk_registry.write_key(
+                self.model_register_dir,
+                ModelBuilder(machine).cache_key,
+                model_dir,
+            )
+
     def _build_all(self, distributed) -> List[Tuple[Any, Machine]]:
         results: Dict[int, Tuple[Any, Machine]] = {}
         plans: Dict[int, _Plan] = {}
         serial: List[int] = []
 
+        # resume prefilter. Registry lookups (cheap) run threaded for the
+        # whole fleet; every process sees the same hit set, and each hit is
+        # OWNED by exactly one process (serial-machine round-robin with its
+        # own counter) — the owner unpickles and returns it, the others skip
+        # it entirely. Without ownership every host would return, report,
+        # and re-persist the whole cached fleet.
+        cached_results: Dict[int, Tuple[Any, Machine]] = {}
+        foreign_cached: set = set()
+        if self.model_register_dir:
+            idxs = list(range(len(self.machines)))
+            with ThreadPoolExecutor(max_workers=min(16, len(idxs))) as pool:
+                paths = list(
+                    pool.map(lambda i: self._cached_path(self.machines[i]), idxs)
+                )
+            owned_hits = []
+            for ordinal, (i, path) in enumerate(
+                (i, p) for i, p in zip(idxs, paths) if p
+            ):
+                if distributed.owns_serial_machine(ordinal):
+                    owned_hits.append((i, path))
+                else:
+                    foreign_cached.add(i)
+            if owned_hits:
+                with ThreadPoolExecutor(
+                    max_workers=min(16, len(owned_hits))
+                ) as pool:
+                    loaded = pool.map(
+                        lambda ip: ModelBuilder.load_from_cache(ip[1]), owned_hits
+                    )
+                    cached_results = {i: c for (i, _), c in zip(owned_hits, loaded)}
+
         for i, machine in enumerate(self.machines):
+            if i in foreign_cached:
+                continue  # cached; another process owns and returns it
+            if i in cached_results:
+                cached = cached_results[i]
+                logger.info("Machine %s: loaded from cache", machine.name)
+                results[i] = cached
+                model_dir = self._machine_output_dir(machine.name)
+                if model_dir and not os.path.exists(
+                    os.path.join(model_dir, "model.pkl")
+                ):
+                    # cache hit from a previous run's output_dir; materialize
+                    # the artifact in this run's tree too
+                    self._persist(machine, *cached)
+                continue
             plan = _plan_machine(machine)
             if plan is None:
                 serial.append(i)
@@ -541,7 +633,10 @@ class BatchedModelBuilder:
             if not distributed.owns_serial_machine(ordinal):
                 continue
             logger.info("Machine %s: serial fallback", self.machines[i].name)
-            results[i] = ModelBuilder(self.machines[i]).build()
+            results[i] = ModelBuilder(self.machines[i]).build(
+                output_dir=self._machine_output_dir(self.machines[i].name),
+                model_register_dir=self.model_register_dir,
+            )
 
         # fetch data concurrently (provider I/O is the per-machine serial cost
         # the reference paid per pod), then bucket by (spec, shapes, config)
@@ -657,6 +752,12 @@ class BatchedModelBuilder:
 
         def enqueue_assembly(pool, fetched, chunk_start):
             group, rows, params_stack, losses, fold_preds = fetched
+            # provisional per-machine duration for checkpointed metadata: the
+            # wall so far over the machines so far (the bucket-level
+            # apportionment below refreshes it once the bucket completes,
+            # but a mid-bucket kill must not leave zeros behind)
+            n_done = chunk_start + len(group)
+            per_machine_est = (time.time() - t0) / max(n_done, 1)
             for j, row in enumerate(int(r) for r in rows):
                 if row >= len(group):
                     continue  # padding rows replicate group[0]; skip
@@ -666,8 +767,8 @@ class BatchedModelBuilder:
                     pool.submit(
                         lambda idx, plan, p, l, fp: (
                             idx,
-                            self._assemble(
-                                plan, p, l, fp, fold_bounds, 0.0, 0.0
+                            self._assemble_and_persist(
+                                plan, p, l, fp, fold_bounds, per_machine_est
                             ),
                         ),
                         global_idxs[chunk_start + row],
@@ -708,9 +809,33 @@ class BatchedModelBuilder:
             build_meta = machine_out.metadata.build_metadata.model
             build_meta.model_training_duration_sec = fit_share
             build_meta.cross_validation.cv_duration_sec = cv_share
+        if self.output_dir:
+            # checkpointed artifacts were written at assembly time with
+            # chunk-level duration estimates — the apportionment above needs
+            # the full bucket wall; refresh just their metadata.json
+            # (atomic: a kill mid-refresh must not corrupt a registered
+            # artifact)
+            for _, (_, machine_out) in out:
+                serializer.dump_metadata(
+                    self._machine_output_dir(machine_out.name),
+                    machine_out.to_dict(),
+                )
         return out
 
     # --------------------------------------------------------- assembly
+    def _assemble_and_persist(
+        self, plan: _Plan, params, losses, fold_preds, fold_bounds,
+        per_machine_est: float,
+    ) -> Tuple[Any, Machine]:
+        n_stages = len(fold_bounds) + 1
+        built = self._assemble(
+            plan, params, losses, fold_preds, fold_bounds,
+            per_machine_est / n_stages,
+            per_machine_est * len(fold_bounds) / n_stages,
+        )
+        self._persist(plan.machine, *built)
+        return built
+
     def _assemble(
         self,
         plan: _Plan,

@@ -18,7 +18,7 @@ from .from_definition import (
     load_params_from_definition,
 )
 from .into_definition import into_definition, load_definition_from_params
-from .serializer import dump, dumps, load, loads, load_metadata, metadata_path
+from .serializer import dump, dump_metadata, dumps, load, loads, load_metadata, metadata_path
 
 __all__ = [
     "from_definition",
@@ -30,5 +30,6 @@ __all__ = [
     "load",
     "loads",
     "load_metadata",
+    "dump_metadata",
     "metadata_path",
 ]

@@ -55,11 +55,22 @@ def load(source_dir: Union[os.PathLike, str]) -> Any:
         return pickle.load(f)
 
 
+def dump_metadata(dest_dir: Union[os.PathLike, str], metadata: dict) -> None:
+    """Write ``metadata.json`` atomically (temp + rename): an artifact whose
+    registry entry already exists must never be observable half-written —
+    a crashed fleet build resumes by loading exactly these files."""
+    os.makedirs(dest_dir, exist_ok=True)
+    final = os.path.join(dest_dir, "metadata.json")
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        simplejson.dump(metadata, f, default=str)
+    os.replace(tmp, final)
+
+
 def dump(obj: object, dest_dir: Union[os.PathLike, str], metadata: dict = None):
     """Serialize ``obj`` (and optional metadata) into ``dest_dir``."""
     os.makedirs(dest_dir, exist_ok=True)
     with open(os.path.join(dest_dir, "model.pkl"), "wb") as m:
         pickle.dump(obj, m)
     if metadata is not None:
-        with open(os.path.join(dest_dir, "metadata.json"), "w") as f:
-            simplejson.dump(metadata, f, default=str)
+        dump_metadata(dest_dir, metadata)

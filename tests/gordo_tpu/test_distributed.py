@@ -189,3 +189,89 @@ def test_distributed_matches_single_process(dist_outdir):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
             )
+
+
+WORKER_RESUME = """
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+from gordo_tpu.parallel import BatchedModelBuilder, distributed
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+import yaml
+
+pid = int(sys.argv[1])
+outdir = sys.argv[2]
+coordinator = sys.argv[3]
+tag = sys.argv[4]
+
+multi = distributed.initialize(coordinator, num_processes=2, process_id=pid)
+assert multi, "expected a multi-process world"
+
+with open(os.path.join(outdir, "config.yaml")) as f:
+    config = yaml.safe_load(f)
+norm = NormalizedConfig(config, project_name="dist-test")
+results = BatchedModelBuilder(
+    norm.machines,
+    output_dir=os.path.join(outdir, "models"),
+    model_register_dir=os.path.join(outdir, "registry"),
+).build()
+
+rows = [
+    [
+        m.name,
+        (m.metadata.user_defined or {{}}).get("build-metadata", {{}})
+        == {{"from_cache": True}},
+    ]
+    for _, m in results
+]
+with open(os.path.join(outdir, "resume-{{}}-{{}}.json".format(tag, pid)), "w") as f:
+    json.dump(rows, f)
+print("worker", pid, tag, "done", flush=True)
+"""
+
+
+def _run_resume_workers(outdir: str, tag: str) -> list:
+    worker_py = os.path.join(outdir, "worker_resume.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER_RESUME.format(repo=REPO))
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if not k.startswith("XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_py, str(pid), outdir, coordinator, tag],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    outputs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, out in zip(procs, outputs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    manifests = []
+    for pid in range(2):
+        with open(os.path.join(outdir, f"resume-{tag}-{pid}.json")) as f:
+            manifests.append(json.load(f))
+    return manifests
+
+
+def test_multiprocess_cache_resume():
+    """Second 2-process run of the same fleet: every machine comes from
+    cache, is returned by exactly ONE process, and both processes share the
+    load — the ownership rule that keeps reporters from firing twice."""
+    outdir = tempfile.mkdtemp(prefix="gordo-dist-resume-")
+    with open(os.path.join(outdir, "config.yaml"), "w") as f:
+        yaml.safe_dump(CONFIG, f)
+
+    first = _run_resume_workers(outdir, "first")
+    built = [name for m in first for name, _ in m]
+    assert sorted(built) == sorted(f"dist-m{i}" for i in range(N_MACHINES))
+    assert not any(cached for m in first for _, cached in m)
+
+    second = _run_resume_workers(outdir, "second")
+    resumed = [name for m in second for name, _ in m]
+    assert sorted(resumed) == sorted(built)
+    assert len(resumed) == len(set(resumed))  # exactly one owner each
+    assert all(cached for m in second for _, cached in m)
+    assert all(len(m) > 0 for m in second)  # both processes own a share

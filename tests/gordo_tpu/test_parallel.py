@@ -330,3 +330,71 @@ def test_chunked_build_matches_unchunked():
     assert np.isclose(
         small[0][0].aggregate_threshold_, big[0][0].aggregate_threshold_
     )
+
+
+def _cache_marker(machine_out):
+    return (machine_out.metadata.user_defined or {}).get(
+        "build-metadata", {}
+    ) == {"from_cache": True}
+
+
+def test_fleet_checkpoint_resume(tmp_path):
+    """A fleet build with output/register dirs persists each machine as it
+    finishes; a rerun loads everything from cache, and wiping one machine's
+    cache entry retrains only that machine."""
+    import os
+    import shutil
+
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.util import disk_registry
+
+    config = "machines:" + "".join(_machine_block(f"ckpt-{i}") for i in range(4))
+    out_dir, reg_dir = str(tmp_path / "out"), str(tmp_path / "reg")
+
+    machines = _machines(config)
+    first = BatchedModelBuilder(
+        machines, output_dir=out_dir, model_register_dir=reg_dir
+    ).build()
+    assert len(first) == 4
+    for _, mo in first:
+        assert not _cache_marker(mo)
+        assert os.path.exists(os.path.join(out_dir, mo.name, "model.pkl"))
+        assert os.path.exists(os.path.join(out_dir, mo.name, "metadata.json"))
+        # checkpointed metadata carries the apportioned durations, not the
+        # provisional zeros written at assembly time
+        from gordo_tpu import serializer
+
+        meta = serializer.load_metadata(os.path.join(out_dir, mo.name))
+        assert (
+            meta["metadata"]["build_metadata"]["model"]
+            ["model_training_duration_sec"] > 0.0
+        )
+
+    second = BatchedModelBuilder(
+        _machines(config), output_dir=out_dir, model_register_dir=reg_dir
+    ).build()
+    assert all(_cache_marker(mo) for _, mo in second)
+
+    # wipe one machine's entry: only it retrains
+    victim = machines[2]
+    disk_registry.delete_value(reg_dir, ModelBuilder(victim).cache_key)
+    shutil.rmtree(os.path.join(out_dir, victim.name))
+    third = BatchedModelBuilder(
+        _machines(config), output_dir=out_dir, model_register_dir=reg_dir
+    ).build()
+    markers = {mo.name: _cache_marker(mo) for _, mo in third}
+    assert markers == {
+        "ckpt-0": True, "ckpt-1": True, "ckpt-2": False, "ckpt-3": True,
+    }
+    assert os.path.exists(os.path.join(out_dir, victim.name, "model.pkl"))
+
+
+def test_fleet_replace_cache_retrains(tmp_path):
+    config = "machines:" + _machine_block("rc-0")
+    out_dir, reg_dir = str(tmp_path / "out"), str(tmp_path / "reg")
+    kwargs = dict(output_dir=out_dir, model_register_dir=reg_dir)
+    BatchedModelBuilder(_machines(config), **kwargs).build()
+    again = BatchedModelBuilder(
+        _machines(config), replace_cache=True, **kwargs
+    ).build()
+    assert not _cache_marker(again[0][1])
