@@ -107,7 +107,9 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         return {k: kwargs[k] for k in self.supported_fit_args if k in kwargs}
 
     # estimator-level kwargs consumed by build_spec itself, never factories
-    _spec_level_kwargs = ("compute_dtype", "tensor_parallel", "remat")
+    _spec_level_kwargs = (
+        "compute_dtype", "tensor_parallel", "remat", "pipeline_parallel",
+    )
 
     def _factory_kwargs(self):
         out = {
@@ -149,6 +151,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             spec = prepare_tp_spec(
                 dataclasses.replace(spec, tensor_parallel=tensor_parallel)
             )
+        pipeline_parallel = int(self.kwargs.get("pipeline_parallel", 0) or 0)
+        if pipeline_parallel > 1:
+            from gordo_tpu.parallel.pipeline_parallel import prepare_pp_spec
+
+            spec = prepare_pp_spec(
+                dataclasses.replace(spec, pipeline_parallel=pipeline_parallel)
+            )
         return spec
 
     def _build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
@@ -175,6 +184,14 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
         fit_args = dict(self.extract_supported_fit_args(self.kwargs))
         fit_args.update(self.extract_supported_fit_args(kwargs))
+        pp = int(getattr(spec, "pipeline_parallel", 0) or 0)
+        if pp > 1 and int(fit_args.get("batch_size", 32)) % pp:
+            # a mismatched batch would silently run every training step on
+            # the sequential fallback — the pipeline would never engage
+            raise ValueError(
+                f"pipeline_parallel={pp} needs batch_size divisible by the "
+                f"stage count, got batch_size={fit_args.get('batch_size', 32)}"
+            )
         callbacks = fit_args.get("callbacks") or []
         if callbacks:
             from gordo_tpu.serializer.from_definition import _build_callbacks

@@ -128,6 +128,10 @@ class ModelSpec:
     # pass (jax.checkpoint): activations are recomputed instead of stored,
     # trading FLOPs for HBM — the standard long-window training lever on TPU
     remat: bool = False
+    # stream microbatches through the Transformer blocks split into N
+    # pipeline stages over a `pipe` mesh axis (parallel/pipeline_parallel.py).
+    # 0/1 = off. Pipelined models keep off the vmap paths, like ring/TP
+    pipeline_parallel: int = 0
 
     @property
     def is_recurrent(self) -> bool:

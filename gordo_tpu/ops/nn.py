@@ -312,9 +312,28 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
             return jax.checkpoint(functools.partial(fn, layer))(p, x)
         return fn(layer, p, x)
 
+    # pipeline parallelism: the contiguous TransformerBlock run executes as
+    # one GPipe shard_map over the `pipe` mesh axis instead of this loop
+    # (parallel/pipeline_parallel.py); remaining layers run replicated
+    pp_blocks = (
+        [i for i, l in enumerate(spec.layers) if isinstance(l, TransformerBlock)]
+        if int(getattr(spec, "pipeline_parallel", 0) or 0) > 1
+        else []
+    )
+
     penalty = jnp.asarray(0.0, jnp.float32)
-    for layer, p in zip(spec.layers, params):
-        if isinstance(layer, DenseLayer):
+    for i, (layer, p) in enumerate(zip(spec.layers, params)):
+        if pp_blocks and i in pp_blocks:
+            if i != pp_blocks[0]:
+                continue  # consumed by the pipeline call below
+            from gordo_tpu.parallel.pipeline_parallel import (
+                apply_pipelined_blocks,
+            )
+
+            out = apply_pipelined_blocks(
+                spec, layer, [params[j] for j in pp_blocks], out
+            )
+        elif isinstance(layer, DenseLayer):
             out = _apply_dense(layer, p, out)
             if layer.l1_activity > 0.0:
                 penalty = penalty + layer.l1_activity * jnp.sum(

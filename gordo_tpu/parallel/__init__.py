@@ -16,6 +16,7 @@ from .mesh import default_mesh, machines_sharding
 from .batch_trainer import BatchedModelBuilder
 from .ring_attention import make_ring_attention, sequence_sharding
 from .tensor_parallel import prepare_tp_spec, shard_params_tp, tp_mesh
+from .pipeline_parallel import make_pipeline_blocks_fn, prepare_pp_spec, pp_mesh
 
 __all__ = [
     "default_mesh",
@@ -26,4 +27,7 @@ __all__ = [
     "prepare_tp_spec",
     "shard_params_tp",
     "tp_mesh",
+    "make_pipeline_blocks_fn",
+    "prepare_pp_spec",
+    "pp_mesh",
 ]

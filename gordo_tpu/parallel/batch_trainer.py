@@ -227,11 +227,12 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         # ring attention is shard_map over the whole mesh — it cannot run
         # under this builder's vmap-over-machines; serial path owns it
         return None
+    from gordo_tpu.parallel.pipeline_parallel import pp_degree
     from gordo_tpu.parallel.tensor_parallel import tp_degree
 
-    if tp_degree(spec) > 1:
-        # model-axis-sharded params claim the mesh for ONE machine; the
-        # serial path owns TP machines (parallel/tensor_parallel.py)
+    if tp_degree(spec) > 1 or pp_degree(spec) > 1:
+        # model-axis-sharded params / the pipeline's shard_map claim the
+        # mesh for ONE machine; the serial path owns such machines
         return None
 
     return _Plan(
@@ -542,13 +543,14 @@ class BatchedModelBuilder:
 
     def _cached_path(self, machine: Machine) -> Optional[str]:
         """Registry lookup only (no unpickle); handles replace_cache."""
-        builder = ModelBuilder(machine)
         if self.replace_cache:
             from gordo_tpu.util import disk_registry
 
-            disk_registry.delete_value(self.model_register_dir, builder.cache_key)
+            disk_registry.delete_value(
+                self.model_register_dir, ModelBuilder.calculate_cache_key(machine)
+            )
             return None
-        return builder.check_cache(self.model_register_dir)
+        return ModelBuilder(machine).check_cache(self.model_register_dir)
 
     def _persist(self, machine: Machine, model, machine_out: Machine) -> None:
         """Dump + register one machine the moment it is assembled, so an
@@ -563,7 +565,7 @@ class BatchedModelBuilder:
 
             disk_registry.write_key(
                 self.model_register_dir,
-                ModelBuilder(machine).cache_key,
+                ModelBuilder.calculate_cache_key(machine),
                 model_dir,
             )
 
@@ -580,7 +582,7 @@ class BatchedModelBuilder:
         # and re-persist the whole cached fleet.
         cached_results: Dict[int, Tuple[Any, Machine]] = {}
         foreign_cached: set = set()
-        if self.model_register_dir:
+        if self.model_register_dir and self.machines:
             idxs = list(range(len(self.machines)))
             with ThreadPoolExecutor(max_workers=min(16, len(idxs))) as pool:
                 paths = list(
