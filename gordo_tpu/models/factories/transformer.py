@@ -19,6 +19,7 @@ from gordo_tpu.models.register import register_model_builder
 from gordo_tpu.models.spec import (
     DenseLayer,
     ModelSpec,
+    MoEBlock,
     PoolLayer,
     PositionalEncoding,
     TransformerBlock,
@@ -69,6 +70,79 @@ def transformer_model(
                 d_model=int(d_model),
                 num_heads=int(num_heads),
                 ff_dim=int(ff_dim),
+                activation=func,
+                causal=bool(causal),
+                attention_impl=attention,
+            )
+        )
+    layers.append(PoolLayer(mode=pool))
+    layers.append(DenseLayer(units=int(n_features_out), activation=out_func))
+
+    loss = (compile_kwargs or {}).get("loss", "mse")
+    return ModelSpec(
+        layers=tuple(layers),
+        n_features=int(n_features),
+        n_features_out=int(n_features_out),
+        lookback_window=int(lookback_window),
+        lookahead=int(lookahead),
+        optimizer=_optimizer_spec(optimizer, optimizer_kwargs),
+        loss=loss,
+    )
+
+
+@register_model_builder(type="TransformerAutoEncoder")
+@register_model_builder(type="TransformerForecast")
+def moe_transformer_model(
+    n_features: int,
+    n_features_out: int = None,
+    lookback_window: int = 144,
+    d_model: int = 64,
+    num_heads: int = 4,
+    num_experts: int = 8,
+    expert_dim: int = 128,
+    capacity_factor: float = 1.25,
+    num_blocks: int = 2,
+    func: str = "relu",
+    out_func: str = "linear",
+    causal: bool = True,
+    pool: str = "last",
+    attention: str = "auto",
+    optimizer: str = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compile_kwargs: Optional[Dict[str, Any]] = None,
+    lookahead: int = 0,
+    **kwargs,
+) -> ModelSpec:
+    """Windowed Transformer encoder with Switch-style MoE FFNs: each
+    token's feedforward runs on its top-1 routed expert (hard capacity,
+    over-capacity tokens pass through). With ``expert_parallel: N`` the
+    expert weights shard over an N-chip ``expert`` mesh axis."""
+    n_features_out = n_features_out or n_features
+    if num_blocks < 1:
+        raise ValueError("num_blocks must be >= 1")
+    if lookback_window < 2:
+        raise ValueError(
+            f"moe_transformer_model requires lookback_window >= 2, "
+            f"got {lookback_window}"
+        )
+    if num_experts < 2:
+        raise ValueError("num_experts must be >= 2")
+    if attention not in ("auto", "xla", "flash", "ring"):
+        raise ValueError(
+            f"attention must be one of auto|xla|flash|ring, got {attention!r}"
+        )
+    layers = [
+        DenseLayer(units=int(d_model), activation="linear"),
+        PositionalEncoding(),
+    ]
+    for _ in range(int(num_blocks)):
+        layers.append(
+            MoEBlock(
+                d_model=int(d_model),
+                num_heads=int(num_heads),
+                num_experts=int(num_experts),
+                expert_dim=int(expert_dim),
+                capacity_factor=float(capacity_factor),
                 activation=func,
                 causal=bool(causal),
                 attention_impl=attention,

@@ -108,7 +108,11 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
     # estimator-level kwargs consumed by build_spec itself, never factories
     _spec_level_kwargs = (
-        "compute_dtype", "tensor_parallel", "remat", "pipeline_parallel",
+        "compute_dtype",
+        "tensor_parallel",
+        "remat",
+        "pipeline_parallel",
+        "expert_parallel",
     )
 
     def _factory_kwargs(self):
@@ -157,6 +161,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
             spec = prepare_pp_spec(
                 dataclasses.replace(spec, pipeline_parallel=pipeline_parallel)
+            )
+        expert_parallel = int(self.kwargs.get("expert_parallel", 0) or 0)
+        if expert_parallel > 1:
+            from gordo_tpu.parallel.expert_parallel import prepare_ep_spec
+
+            spec = prepare_ep_spec(
+                dataclasses.replace(spec, expert_parallel=expert_parallel)
             )
         return spec
 

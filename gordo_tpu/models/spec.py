@@ -58,6 +58,29 @@ class TransformerBlock:
 
 
 @dataclass(frozen=True)
+class MoEBlock:
+    """
+    Mixture-of-experts Transformer encoder block (new capability — the
+    reference has no attention models at all): pre-LN MHA + residual, then a
+    Switch-style routed FFN + residual. Each token is routed to its top-1
+    expert by a learned router; experts have a hard capacity
+    ``ceil(tokens * capacity_factor / num_experts)`` and over-capacity
+    tokens pass through unchanged (standard Switch semantics). With
+    ``expert_parallel: N`` the expert weights shard over an ``expert`` mesh
+    axis (parallel/expert_parallel.py).
+    """
+
+    d_model: int
+    num_heads: int = 4
+    num_experts: int = 8
+    expert_dim: int = 128
+    capacity_factor: float = 1.25
+    activation: str = "relu"
+    causal: bool = False
+    attention_impl: str = "auto"
+
+
+@dataclass(frozen=True)
 class TCNBlock:
     """
     Temporal-convolutional residual block: two causal dilated 1-D convs with
@@ -79,7 +102,13 @@ class PoolLayer:
 
 
 LayerSpec = Union[
-    DenseLayer, LSTMLayer, PositionalEncoding, TransformerBlock, TCNBlock, PoolLayer
+    DenseLayer,
+    LSTMLayer,
+    PositionalEncoding,
+    TransformerBlock,
+    MoEBlock,
+    TCNBlock,
+    PoolLayer,
 ]
 
 
@@ -132,6 +161,9 @@ class ModelSpec:
     # pipeline stages over a `pipe` mesh axis (parallel/pipeline_parallel.py).
     # 0/1 = off. Pipelined models keep off the vmap paths, like ring/TP
     pipeline_parallel: int = 0
+    # shard MoE expert weights over an N-chip `expert` mesh axis
+    # (parallel/expert_parallel.py). 0/1 = all experts on every chip
+    expert_parallel: int = 0
 
     @property
     def is_recurrent(self) -> bool:

@@ -20,6 +20,7 @@ from gordo_tpu.models.spec import (
     DenseLayer,
     LSTMLayer,
     ModelSpec,
+    MoEBlock,
     PoolLayer,
     PositionalEncoding,
     TCNBlock,
@@ -84,14 +85,9 @@ def init_lstm_layer(rng, in_dim: int, units: int) -> Dict[str, jnp.ndarray]:
     }
 
 
-def init_transformer_block(rng, in_dim: int, layer: TransformerBlock):
-    if in_dim != layer.d_model:
-        raise ValueError(
-            f"TransformerBlock d_model={layer.d_model} but incoming dim is "
-            f"{in_dim}; insert a Dense projection first"
-        )
-    d, ff = layer.d_model, layer.ff_dim
-    ks = jax.random.split(rng, 6)
+def _init_attention_params(ks, d: int) -> Dict[str, jnp.ndarray]:
+    """Pre-LN MHA sublayer params shared by Transformer and MoE blocks
+    (ks: four RNG keys for wq/wk/wv/wo)."""
     return {
         "ln1_scale": jnp.ones((d,), jnp.float32),
         "ln1_bias": jnp.zeros((d,), jnp.float32),
@@ -105,10 +101,47 @@ def init_transformer_block(rng, in_dim: int, layer: TransformerBlock):
         "bo": jnp.zeros((d,), jnp.float32),
         "ln2_scale": jnp.ones((d,), jnp.float32),
         "ln2_bias": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def init_transformer_block(rng, in_dim: int, layer: TransformerBlock):
+    if in_dim != layer.d_model:
+        raise ValueError(
+            f"TransformerBlock d_model={layer.d_model} but incoming dim is "
+            f"{in_dim}; insert a Dense projection first"
+        )
+    d, ff = layer.d_model, layer.ff_dim
+    ks = jax.random.split(rng, 6)
+    return {
+        **_init_attention_params(ks[:4], d),
         "w_ff1": _glorot_uniform(ks[4], (d, ff)),
         "b_ff1": jnp.zeros((ff,), jnp.float32),
         "w_ff2": _glorot_uniform(ks[5], (ff, d)),
         "b_ff2": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def init_moe_block(rng, in_dim: int, layer: MoEBlock):
+    if in_dim != layer.d_model:
+        raise ValueError(
+            f"MoEBlock d_model={layer.d_model} but incoming dim is "
+            f"{in_dim}; insert a Dense projection first"
+        )
+    d, f, e = layer.d_model, layer.expert_dim, layer.num_experts
+    ks = jax.random.split(rng, 7)
+    return {
+        **_init_attention_params(ks[:4], d),
+        "router": _glorot_uniform(ks[4], (d, e)),
+        # experts stacked on a leading axis — the axis expert parallelism
+        # shards over (parallel/expert_parallel.py)
+        "w1": jax.vmap(lambda k: _glorot_uniform(k, (d, f)))(
+            jax.random.split(ks[5], e)
+        ),
+        "b1": jnp.zeros((e, f), jnp.float32),
+        "w2": jax.vmap(lambda k: _glorot_uniform(k, (f, d)))(
+            jax.random.split(ks[6], e)
+        ),
+        "b2": jnp.zeros((e, d), jnp.float32),
     }
 
 
@@ -131,7 +164,7 @@ def layer_out_dim(layer, in_dim: int) -> int:
     """Feature dimension a layer produces given its input dimension."""
     if isinstance(layer, (DenseLayer, LSTMLayer)):
         return layer.units
-    if isinstance(layer, TransformerBlock):
+    if isinstance(layer, (TransformerBlock, MoEBlock)):
         return layer.d_model
     if isinstance(layer, TCNBlock):
         return layer.filters
@@ -152,6 +185,8 @@ def init_model_params(rng: jax.Array, spec: ModelSpec) -> Params:
             params.append(init_lstm_layer(layer_rng, in_dim, layer.units))
         elif isinstance(layer, TransformerBlock):
             params.append(init_transformer_block(layer_rng, in_dim, layer))
+        elif isinstance(layer, MoEBlock):
+            params.append(init_moe_block(layer_rng, in_dim, layer))
         elif isinstance(layer, TCNBlock):
             params.append(init_tcn_block(layer_rng, in_dim, layer))
         elif isinstance(layer, (PositionalEncoding, PoolLayer)):
@@ -224,8 +259,9 @@ def _apply_positional_encoding(layer: PositionalEncoding, x):
     return x + pe[None, :, :]
 
 
-def _apply_transformer_block(layer: TransformerBlock, p, x):
-    """Pre-LN encoder block. x: (batch, time, d_model)."""
+def _attention_sublayer(layer, p, x):
+    """Pre-LN MHA + residual, shared by TransformerBlock and MoEBlock
+    (same param keys, same dispatch)."""
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
@@ -241,10 +277,100 @@ def _apply_transformer_block(layer: TransformerBlock, p, x):
         causal=layer.causal,
         impl=None if layer_impl == "auto" else layer_impl,
     )
-    x = x + attn @ p["wo"] + p["bo"]
+    return x + attn @ p["wo"] + p["bo"]
+
+
+def _apply_transformer_block(layer: TransformerBlock, p, x):
+    """Pre-LN encoder block. x: (batch, time, d_model)."""
+    x = _attention_sublayer(layer, p, x)
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = _activation(layer.activation)(h @ p["w_ff1"] + p["b_ff1"])
     return x + ff @ p["w_ff2"] + p["b_ff2"]
+
+
+def moe_capacity(layer: MoEBlock, n_tokens: int) -> int:
+    """Per-expert token capacity (Switch Transformer semantics)."""
+    import math
+
+    return max(1, math.ceil(n_tokens * layer.capacity_factor / layer.num_experts))
+
+
+def moe_dispatch_ffn(
+    layer: MoEBlock,
+    expert_w,
+    h: jnp.ndarray,
+    gates: jnp.ndarray,
+    expert_offset: int,
+    n_local: int,
+):
+    """Routed-FFN contribution of ``n_local`` experts starting at
+    ``expert_offset``. Shared by the single-device path (offset 0, all
+    experts) and the expert-parallel shard_map (each device its slice, then
+    psum) — one definition, so the two paths cannot drift.
+
+    ``h``: (N, D) post-LN tokens; ``gates``: (N, E) router softmax over ALL
+    experts (the router is replicated; only expert FFN weights shard).
+    ``expert_w``: dict with ``w1`` (n_local, D, F), ``b1``, ``w2``, ``b2``.
+    Returns (N, D): gate-weighted expert outputs, zeros for tokens routed
+    elsewhere or over capacity.
+
+    Mechanics: top-1 routing; per-expert token position via a one-hot
+    cumsum; tokens scatter into a fixed (n_local, C+1, D) buffer (row C is
+    the overflow dump), experts run as one batched einsum on the MXU, and
+    outputs gather back by the same positions.
+    """
+    n_tokens, d = h.shape
+    cap = moe_capacity(layer, n_tokens)
+    top1 = jnp.argmax(gates, axis=-1)  # (N,)
+    gate = jnp.take_along_axis(gates, top1[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(top1, layer.num_experts, dtype=jnp.float32)
+    # position of each token within its expert's buffer, same for every
+    # shard (cumsum over the full token axis in token order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    pos1 = jnp.take_along_axis(pos, top1[:, None], axis=1)[:, 0].astype(jnp.int32)
+    local = jnp.logical_and(
+        top1 >= expert_offset, top1 < expert_offset + n_local
+    )
+    keep = jnp.logical_and(local, pos1 < cap)
+    idx_e = jnp.where(keep, top1 - expert_offset, 0)
+    idx_c = jnp.where(keep, pos1, cap)  # overflow/foreign -> dump row
+    buf = jnp.zeros((n_local, cap + 1, d), h.dtype)
+    buf = buf.at[idx_e, idx_c].set(h)[:, :cap]
+    act = _activation(layer.activation)
+    mid = act(
+        jnp.einsum("ecd,edf->ecf", buf, expert_w["w1"])
+        + expert_w["b1"][:, None, :].astype(buf.dtype)
+    )
+    out_buf = jnp.einsum("ecf,efd->ecd", mid, expert_w["w2"]) + expert_w[
+        "b2"
+    ][:, None, :].astype(buf.dtype)
+    tok_out = out_buf[idx_e, jnp.clip(pos1, 0, cap - 1)]
+    weight = (gate * keep.astype(gate.dtype)).astype(tok_out.dtype)
+    return tok_out * weight[:, None]
+
+
+def _apply_moe_block(layer: MoEBlock, p, x, ffn_fn=None):
+    """Pre-LN MoE encoder block. x: (batch, time, d_model).
+
+    ``ffn_fn(layer, expert_w, flat, gates)`` overrides the routed-FFN
+    execution — expert parallelism passes its shard_map here; attention and
+    routing are identical either way.
+    """
+    x = _attention_sublayer(layer, p, x)
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    b, t, d = h.shape
+    flat = h.reshape(b * t, d)
+    # router runs in float32: argmax ties and tiny gate logits are routing
+    # decisions, not activations
+    gates = jax.nn.softmax((flat.astype(jnp.float32) @ p["router"]), axis=-1)
+    expert_w = {key: p[key] for key in ("w1", "b1", "w2", "b2")}
+    if ffn_fn is None:
+        ffn = moe_dispatch_ffn(
+            layer, expert_w, flat, gates, 0, layer.num_experts
+        )
+    else:
+        ffn = ffn_fn(layer, expert_w, flat, gates)
+    return x + ffn.reshape(b, t, d)
 
 
 def _causal_conv1d(x, kernel, dilation: int):
@@ -345,6 +471,13 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
             out = _apply_positional_encoding(layer, out)
         elif isinstance(layer, TransformerBlock):
             out = _seq_layer(_apply_transformer_block, layer, p, out)
+        elif isinstance(layer, MoEBlock):
+            if int(getattr(spec, "expert_parallel", 0) or 0) > 1:
+                from gordo_tpu.parallel.expert_parallel import apply_ep_moe_block
+
+                out = apply_ep_moe_block(spec, layer, p, out)
+            else:
+                out = _seq_layer(_apply_moe_block, layer, p, out)
         elif isinstance(layer, TCNBlock):
             out = _seq_layer(_apply_tcn_block, layer, p, out)
         elif isinstance(layer, PoolLayer):

@@ -17,6 +17,7 @@ from .batch_trainer import BatchedModelBuilder
 from .ring_attention import make_ring_attention, sequence_sharding
 from .tensor_parallel import prepare_tp_spec, shard_params_tp, tp_mesh
 from .pipeline_parallel import make_pipeline_blocks_fn, prepare_pp_spec, pp_mesh
+from .expert_parallel import ep_mesh, prepare_ep_spec
 
 __all__ = [
     "default_mesh",
@@ -30,4 +31,6 @@ __all__ = [
     "make_pipeline_blocks_fn",
     "prepare_pp_spec",
     "pp_mesh",
+    "ep_mesh",
+    "prepare_ep_spec",
 ]

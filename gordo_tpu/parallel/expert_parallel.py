@@ -1,0 +1,114 @@
+"""
+Expert parallelism: shard MoE expert weights over an ``expert`` mesh axis.
+
+Fifth and last scaling axis (machines/dp, ring/sp, TP, PP — SURVEY §2: the
+reference's only axis is more pods). A :class:`~gordo_tpu.models.spec.MoEBlock`
+holds E experts stacked on a leading parameter axis; with
+``expert_parallel: N`` that axis shards over N chips — each chip stores and
+runs E/N experts, so expert memory AND routed-FFN compute scale with the
+mesh while the attention/router weights stay replicated.
+
+TPU-first mechanics: tokens are replicated and the router's top-1
+assignment is computed identically on every chip (same cumsum positions,
+same capacity drops — bit-identical to the single-device path). Each chip
+scatters only the tokens routed to ITS experts into its local capacity
+buffer, runs one batched einsum on the MXU, and the gate-weighted outputs
+combine with a single ``psum`` over ICI. No all_to_all is needed because
+the token axis is not sharded here (the fleet dimension is how this
+framework scales batch); the communication cost is one (tokens, d_model)
+all-reduce per block.
+
+The routing math itself lives in :func:`gordo_tpu.ops.nn.moe_dispatch_ffn`
+— one definition shared with the single-device path, so the two cannot
+drift. Like ring/TP/PP, EP specs keep off both vmap paths.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gordo_tpu.models.spec import ModelSpec, MoEBlock
+
+AXIS = "expert"
+
+
+def ep_degree(spec) -> int:
+    """The spec's expert-shard count (0/1 = off); pickle-tolerant."""
+    return int(getattr(spec, "expert_parallel", 0) or 0)
+
+
+def prepare_ep_spec(spec: ModelSpec) -> ModelSpec:
+    """Validate an expert-parallel spec at build time."""
+    ep = ep_degree(spec)
+    if ep <= 1:
+        return spec
+    for other in ("tensor_parallel", "pipeline_parallel"):
+        if int(getattr(spec, other, 0) or 0) > 1:
+            raise ValueError(
+                f"expert_parallel and {other} cannot combine on one spec "
+                f"yet — pick one mesh axis per model"
+            )
+    moe = [l for l in spec.layers if isinstance(l, MoEBlock)]
+    if not moe:
+        raise ValueError(
+            f"expert_parallel={ep} requires MoEBlock layers; "
+            f"got {[type(l).__name__ for l in spec.layers]}"
+        )
+    for layer in moe:
+        if layer.num_experts % ep:
+            raise ValueError(
+                f"expert_parallel={ep} needs num_experts divisible by the "
+                f"shard count, got num_experts={layer.num_experts}"
+            )
+    return spec
+
+
+@functools.lru_cache(maxsize=8)
+def ep_mesh(n_shards: int) -> Mesh:
+    """A 1-D ``expert`` mesh over the first ``n_shards`` addressable devices."""
+    devices = jax.local_devices()
+    if n_shards > len(devices):
+        raise ValueError(
+            f"expert_parallel={n_shards} but only {len(devices)} "
+            f"addressable device(s) ({devices[0].platform})"
+        )
+    return Mesh(devices[:n_shards], (AXIS,))
+
+
+@functools.lru_cache(maxsize=32)
+def _ep_ffn_fn(layer: MoEBlock, n_shards: int):
+    """shard_map'd routed FFN: expert weights sharded, tokens replicated,
+    one psum combines the per-shard contributions."""
+    from jax.experimental.shard_map import shard_map
+
+    from gordo_tpu.ops.nn import moe_dispatch_ffn
+
+    mesh = ep_mesh(n_shards)
+    n_local = layer.num_experts // n_shards
+
+    def local_ffn(expert_w, flat, gates):
+        offset = jax.lax.axis_index(AXIS) * n_local
+        out = moe_dispatch_ffn(layer, expert_w, flat, gates, offset, n_local)
+        return jax.lax.psum(out, AXIS)
+
+    return shard_map(
+        local_ffn,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+
+
+def apply_ep_moe_block(spec: ModelSpec, layer: MoEBlock, p, x):
+    """Apply one MoE block with its experts sharded over the mesh."""
+    from gordo_tpu.ops.nn import _apply_moe_block
+
+    fn = _ep_ffn_fn(layer, ep_degree(spec))
+
+    def ffn(layer_, expert_w, flat, gates):
+        return fn(expert_w, flat, gates)
+
+    return _apply_moe_block(layer, p, x, ffn_fn=ffn)

@@ -309,11 +309,12 @@ def maybe_submit(spec, params, X) -> Optional[np.ndarray]:
         # ring attention (shard_map) cannot run under this batcher's
         # vmap-over-models; such specs always predict direct
         return None
+    from gordo_tpu.parallel.expert_parallel import ep_degree
     from gordo_tpu.parallel.pipeline_parallel import pp_degree
     from gordo_tpu.parallel.tensor_parallel import tp_degree
 
-    if tp_degree(spec) > 1 or pp_degree(spec) > 1:
+    if tp_degree(spec) > 1 or pp_degree(spec) > 1 or ep_degree(spec) > 1:
         # tensor-parallel params are sharded over the mesh, and the
-        # pipeline's shard_map can't nest under vmap — predict direct
+        # pipeline/expert shard_maps can't nest under vmap — predict direct
         return None
     return batcher.submit(spec, params, X)

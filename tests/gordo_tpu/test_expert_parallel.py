@@ -1,0 +1,217 @@
+"""
+MoE Transformer + expert parallelism on the 8-virtual-device CPU mesh.
+
+Contracts: Switch-style routing (top-1, hard capacity, over-capacity
+pass-through) is identical between the single-device path and the
+expert-sharded shard_map (same cumsum positions -> same drops), EP specs
+keep off both vmap paths, and the MoE family rides the normal config /
+serializer / builder machinery.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.models.models import TransformerAutoEncoder
+from gordo_tpu.models.spec import MoEBlock
+from gordo_tpu.ops.nn import (
+    _apply_moe_block,
+    apply_model,
+    init_model_params,
+    init_moe_block,
+    moe_capacity,
+    moe_dispatch_ffn,
+)
+from gordo_tpu.parallel.expert_parallel import (
+    apply_ep_moe_block,
+    ep_degree,
+    prepare_ep_spec,
+)
+
+N_TAGS = 4
+MOE_KW = dict(
+    kind="moe_transformer_model",
+    lookback_window=16,
+    d_model=16,
+    num_heads=2,
+    num_experts=8,
+    expert_dim=32,
+    num_blocks=2,
+    epochs=2,
+    batch_size=32,
+)
+
+
+def _block(**over):
+    base = dict(d_model=16, num_heads=2, num_experts=8, expert_dim=32,
+                attention_impl="xla")
+    base.update(over)
+    return MoEBlock(**base)
+
+
+def test_moe_routing_covers_tokens_and_respects_capacity():
+    layer = _block(capacity_factor=0.5)
+    rng = jax.random.PRNGKey(0)
+    p = init_moe_block(rng, 16, layer)
+    n = 64
+    h = jnp.asarray(np.random.RandomState(0).randn(n, 16), jnp.float32)
+    gates = jax.nn.softmax(h @ p["router"], axis=-1)
+    expert_w = {k: p[k] for k in ("w1", "b1", "w2", "b2")}
+    out = moe_dispatch_ffn(layer, expert_w, h, gates, 0, layer.num_experts)
+    assert out.shape == (n, 16)
+    # tokens over capacity contribute exactly zero (pass-through residual)
+    cap = moe_capacity(layer, n)
+    top1 = np.asarray(jnp.argmax(gates, axis=-1))
+    onehot = np.eye(layer.num_experts)[top1]
+    pos = (np.cumsum(onehot, axis=0) * onehot).sum(-1) - 1
+    dropped = pos >= cap
+    assert dropped.any()  # capacity_factor 0.5 forces drops
+    np.testing.assert_array_equal(np.asarray(out)[dropped], 0.0)
+    assert np.abs(np.asarray(out)[~dropped]).sum() > 0
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_ep_matches_single_device(n_shards):
+    layer = _block()
+    p = init_moe_block(jax.random.PRNGKey(1), 16, layer)
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 12, 16), jnp.float32)
+    single = _apply_moe_block(layer, p, x)
+
+    import dataclasses
+
+    spec = TransformerAutoEncoder(**MOE_KW).build_spec(N_TAGS, N_TAGS)
+    spec = dataclasses.replace(spec, expert_parallel=n_shards)
+    sharded = apply_ep_moe_block(spec, layer, p, x)
+    np.testing.assert_allclose(single, sharded, rtol=2e-4, atol=2e-6)
+
+
+def test_ep_grad_matches_single_device():
+    layer = _block()
+    p = init_moe_block(jax.random.PRNGKey(3), 16, layer)
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 8, 16), jnp.float32)
+
+    import dataclasses
+
+    spec = TransformerAutoEncoder(**MOE_KW).build_spec(N_TAGS, N_TAGS)
+    spec = dataclasses.replace(spec, expert_parallel=4)
+
+    g_single = jax.grad(lambda q: jnp.sum(_apply_moe_block(layer, q, x) ** 2))(p)
+    g_ep = jax.grad(
+        lambda q: jnp.sum(apply_ep_moe_block(spec, layer, q, x) ** 2)
+    )(p)
+    for a, b in zip(jax.tree_util.tree_leaves(g_single),
+                    jax.tree_util.tree_leaves(g_ep)):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=5e-5)
+
+
+def test_moe_model_trains_and_roundtrips():
+    import pickle
+
+    X = np.random.RandomState(5).rand(96, N_TAGS).astype(np.float32)
+    np.random.seed(21)
+    plain = TransformerAutoEncoder(**MOE_KW)
+    plain.fit(X, X)
+    assert np.isfinite(plain.history["loss"]).all()
+    np.random.seed(21)
+    ep = TransformerAutoEncoder(expert_parallel=8, **MOE_KW)
+    ep.fit(X, X)
+    assert ep_degree(ep.spec_) == 8
+    np.testing.assert_allclose(
+        plain.history["loss"], ep.history["loss"], rtol=2e-4
+    )
+    np.testing.assert_allclose(
+        plain.predict(X), ep.predict(X), rtol=2e-4, atol=2e-5
+    )
+    loaded = pickle.loads(pickle.dumps(ep))
+    np.testing.assert_allclose(
+        ep.predict(X), loaded.predict(X), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_ep_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        TransformerAutoEncoder(
+            expert_parallel=8, **{**MOE_KW, "num_experts": 6}
+        ).build_spec(N_TAGS, N_TAGS)
+    with pytest.raises(ValueError, match="MoEBlock"):
+        TransformerAutoEncoder(
+            kind="transformer_model", lookback_window=16, expert_parallel=4
+        ).build_spec(N_TAGS, N_TAGS)
+    # tp+ep on one spec: rejected (tp's transformer-block requirement
+    # fires first in build_spec; prepare_ep_spec's combine check backstops
+    # direct spec construction)
+    with pytest.raises(ValueError, match="TransformerBlock|cannot combine"):
+        TransformerAutoEncoder(
+            expert_parallel=2, tensor_parallel=2, **MOE_KW
+        ).build_spec(N_TAGS, N_TAGS)
+    import dataclasses
+
+    spec = TransformerAutoEncoder(**MOE_KW).build_spec(N_TAGS, N_TAGS)
+    with pytest.raises(ValueError, match="cannot combine"):
+        prepare_ep_spec(
+            dataclasses.replace(spec, expert_parallel=2, pipeline_parallel=2)
+        )
+
+
+def test_ep_machines_take_serial_fallback_and_skip_batcher(monkeypatch):
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel.batch_trainer import _plan_machine
+    from gordo_tpu.server import batcher as batcher_mod
+    from gordo_tpu.server.batcher import maybe_submit
+
+    config = {
+        "name": "ep-machine",
+        "dataset": {
+            "type": "RandomDataset",
+            "tags": [f"ep-tag-{i}" for i in range(N_TAGS)],
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-08T00:00:00+00:00",
+        },
+        "model": {
+            "gordo_tpu.models.models.TransformerAutoEncoder": {
+                **{k: v for k, v in MOE_KW.items() if k != "kind"},
+                "kind": "moe_transformer_model",
+                "expert_parallel": 8,
+            }
+        },
+    }
+    machine = Machine.from_config(config, project_name="ep-test")
+    assert _plan_machine(machine) is None
+
+    spec = TransformerAutoEncoder(
+        expert_parallel=8, **MOE_KW
+    ).build_spec(N_TAGS, N_TAGS)
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    monkeypatch.setattr(
+        batcher_mod.CrossModelBatcher,
+        "submit",
+        lambda self, *a: pytest.fail("ep spec reached the batcher queue"),
+    )
+    assert maybe_submit(spec, None, None) is None
+
+
+def test_moe_without_ep_rides_the_fleet_vmap_path():
+    """Plain MoE machines (expert_parallel off) are batchable like any
+    other spec — routing is pure vmappable array math."""
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel.batch_trainer import _plan_machine
+
+    config = {
+        "name": "moe-plain",
+        "dataset": {
+            "type": "RandomDataset",
+            "tags": [f"mp-{i}" for i in range(N_TAGS)],
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-08T00:00:00+00:00",
+        },
+        "model": {
+            "gordo_tpu.models.models.TransformerAutoEncoder": {
+                **{k: v for k, v in MOE_KW.items() if k != "kind"},
+                "kind": "moe_transformer_model",
+            }
+        },
+    }
+    machine = Machine.from_config(config, project_name="moe-test")
+    assert _plan_machine(machine) is not None
