@@ -420,15 +420,17 @@ def _run_section(name: str) -> dict:
     """
     import subprocess
 
-    # the headline gets a longer leash: a CPU-fallback run still builds the
-    # full 1024-machine fleet plus two torch baselines
-    default = "3600" if name == "headline" else "2400"
     timeout = int(
         os.environ.get(
             f"BENCH_SECTION_TIMEOUT_{name.upper()}",
-            os.environ.get("BENCH_SECTION_TIMEOUT", default),
+            os.environ.get("BENCH_SECTION_TIMEOUT", "2400"),
         )
     )
+    if name == "headline" and "BENCH_SECTION_TIMEOUT_HEADLINE" not in os.environ:
+        # the headline gets a longer leash regardless of the generic knob: a
+        # CPU-fallback run still builds the full 1024-machine fleet plus two
+        # torch baselines
+        timeout = max(timeout, 3600)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--section", name],

@@ -215,3 +215,36 @@ def test_moe_without_ep_rides_the_fleet_vmap_path():
     }
     machine = Machine.from_config(config, project_name="moe-test")
     assert _plan_machine(machine) is not None
+
+
+def test_moe_without_ep_batches_across_models():
+    """Plain MoE predicts fuse through the cross-model batcher — routing is
+    vmappable array math like any other spec."""
+    import threading
+
+    from gordo_tpu.server.batcher import CrossModelBatcher
+
+    X = np.random.RandomState(8).rand(64, N_TAGS).astype(np.float32)
+    small = {**MOE_KW, "num_blocks": 1, "epochs": 1}
+    models = []
+    for seed in range(2):
+        np.random.seed(seed)
+        m = TransformerAutoEncoder(**small)
+        m.fit(X, X)
+        models.append(m)
+    direct = [m.predict(X) for m in models]
+
+    b = CrossModelBatcher(window_ms=20, max_batch=8)
+    results = [None] * len(models)
+
+    def run(i):
+        results[i] = b.submit(models[i].spec_, models[i].params_, X)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for got, want in zip(results, direct):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert b.stats["largest_batch"] == 2

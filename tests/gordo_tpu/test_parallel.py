@@ -398,3 +398,21 @@ def test_fleet_replace_cache_retrains(tmp_path):
         _machines(config), replace_cache=True, **kwargs
     ).build()
     assert not _cache_marker(again[0][1])
+
+
+def test_profile_dir_captures_device_trace(tmp_path, monkeypatch):
+    """GORDO_TPU_PROFILE_DIR wraps the fleet build in jax.profiler.trace
+    and leaves an openable trace on disk (SURVEY §5 tracing hookup)."""
+    import os
+
+    monkeypatch.setenv("GORDO_TPU_PROFILE_DIR", str(tmp_path))
+    config = "machines:" + _machine_block("prof-0")
+    BatchedModelBuilder(_machines(config)).build()
+    trace_root = tmp_path / "batched-build"
+    assert trace_root.exists()
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(trace_root)
+        for f in fs
+    ]
+    assert files, "profiler produced no trace files"
