@@ -26,7 +26,6 @@ drift. Like ring/TP/PP, EP specs keep off both vmap paths.
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gordo_tpu.models.spec import ModelSpec, MoEBlock

@@ -29,12 +29,9 @@ vmap-over-machines/models paths: the pipe claims the mesh for one model.
 
 import functools
 from dataclasses import replace
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gordo_tpu.models.spec import ModelSpec, TransformerBlock
 

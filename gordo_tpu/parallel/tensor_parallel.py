@@ -31,7 +31,7 @@ Interplay with the other axes:
 import functools
 import logging
 from dataclasses import replace
-from typing import Any, Optional, Sequence
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
