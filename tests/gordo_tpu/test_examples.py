@@ -1,31 +1,48 @@
 """
-The shipped example must actually run (reference analog: notebooks executed
-by tests/test_examples.py with the dataset mocked — here the example already
-uses RandomDataProvider, so it runs as-is)."""
+The shipped examples must actually run (reference analog: notebooks executed
+by tests/test_examples.py with the dataset mocked — here the examples already
+use RandomDataProvider, so they run as-is)."""
 
 import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.join(os.path.dirname(__file__), "..", "..")
 
 
-def test_local_workflow_example_runs():
+def _run_example(script: str, timeout: int) -> str:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    # drop accelerator site hooks: the example must run on a clean CPU host
+    # drop accelerator site hooks: examples must run on a clean CPU host
     env["PYTHONPATH"] = ""
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", "local_workflow.py")],
+        [sys.executable, os.path.join(REPO, "examples", script)],
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=timeout,
         env=env,
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "full YAML -> build -> serve -> predict loop complete" in proc.stdout
+    return proc.stdout
+
+
+@pytest.mark.parametrize(
+    "script,sentinel,timeout",
+    [
+        (
+            "local_workflow.py",
+            "full YAML -> build -> serve -> predict loop complete",
+            600,
+        ),
+        ("parallel_axes.py", "all five scaling axes ran from config", 900),
+    ],
+)
+def test_example_runs(script, sentinel, timeout):
+    assert sentinel in _run_example(script, timeout)
 
 
 def test_notebook_code_cells_execute():
@@ -53,3 +70,20 @@ def test_notebook_code_cells_execute():
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
+
+
+def test_parallel_axes_example_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = ""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "parallel_axes.py")],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "all five scaling axes ran from config" in proc.stdout
