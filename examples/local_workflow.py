@@ -17,12 +17,15 @@ import tempfile
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# CPU with an 8-device virtual mesh: same code path as a TPU slice
+# CPU with an 8-device virtual mesh: same code path as a TPU slice.
+# Platform selection must happen BEFORE jax initializes a backend (a config
+# update after jax.default_backend() is a silent no-op); TPU users export
+# JAX_PLATFORMS=tpu.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 
-if jax.default_backend() not in ("tpu",):
-    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import numpy as np
 import pandas as pd

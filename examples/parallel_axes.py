@@ -17,11 +17,14 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# platform selection must happen BEFORE jax initializes a backend (a
+# config update after jax.default_backend() is a silent no-op). Default to
+# the CPU virtual mesh; TPU users export JAX_PLATFORMS=tpu.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 
-if jax.default_backend() not in ("tpu",):
-    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import numpy as np
 

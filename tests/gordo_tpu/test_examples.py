@@ -70,20 +70,3 @@ def test_notebook_code_cells_execute():
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-
-
-def test_parallel_axes_example_runs():
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    env["PYTHONPATH"] = ""
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", "parallel_axes.py")],
-        capture_output=True,
-        text=True,
-        timeout=900,
-        env=env,
-        cwd=REPO,
-    )
-    assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "all five scaling axes ran from config" in proc.stdout

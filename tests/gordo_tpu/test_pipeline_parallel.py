@@ -215,3 +215,35 @@ def test_pp_remat_checkpoints_inside_pipeline():
     jaxpr = str(jax.make_jaxpr(jax.grad(loss))(params))
     assert "remat" in jaxpr
     assert np.all(np.isfinite(jax.grad(loss)(params)[0]["kernel"]))
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {"tensor_parallel": 8, "num_heads": 8},
+        {"pipeline_parallel": 4},
+        {"attention": "ring", "lookback_window": 16},
+    ],
+    ids=["tp", "pp", "ring"],
+)
+def test_axes_compose_with_bf16_and_remat(extra):
+    """Every per-model axis must train finite under the MXU-native dtype
+    and rematerialization — the combination real TPU configs use."""
+    kwargs = {**PP_KW, "compute_dtype": "bfloat16", "remat": True, **extra}
+    X = np.random.RandomState(9).rand(96, N_TAGS).astype(np.float32)
+    model = TransformerAutoEncoder(**kwargs)
+    model.fit(X, X)
+    assert np.isfinite(model.history["loss"]).all()
+    assert np.isfinite(model.predict(X)).all()
+
+
+def test_moe_composes_with_bf16_and_remat():
+    from tests.gordo_tpu.test_expert_parallel import MOE_KW
+
+    X = np.random.RandomState(9).rand(96, N_TAGS).astype(np.float32)
+    model = TransformerAutoEncoder(
+        compute_dtype="bfloat16", remat=True, expert_parallel=8, **MOE_KW
+    )
+    model.fit(X, X)
+    assert np.isfinite(model.history["loss"]).all()
+    assert np.isfinite(model.predict(X)).all()
