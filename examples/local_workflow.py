@@ -23,9 +23,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # JAX_PLATFORMS=tpu.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-import jax
-
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import jax  # noqa: E402 — platform chosen via env above
 
 import numpy as np
 import pandas as pd
