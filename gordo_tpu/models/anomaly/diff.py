@@ -423,6 +423,18 @@ class DiffBasedKFCVAnomalyDetector(DiffBasedAnomalyDetector):
         cv=None,
         **kwargs,
     ):
+        offset = self._estimator_offset()
+        if offset:
+            # KFold validation errors are scatter-assigned per test row; an
+            # offset (windowed) model predicts fewer rows than each fold
+            # holds, so the scatter cannot line up. The reference has the
+            # identical limitation, just as an inscrutable numpy error
+            # (gordo/machine/model/anomaly/diff.py:598-609)
+            raise ValueError(
+                f"DiffBasedKFCVAnomalyDetector requires an offset-free base "
+                f"estimator (got lookback/lookahead offset {offset}); use "
+                f"DiffBasedAnomalyDetector for windowed models"
+            )
         if cv is None:
             cv = KFold(n_splits=5, shuffle=True, random_state=0)
         kwargs.update(dict(return_estimator=True, cv=cv))
@@ -453,6 +465,14 @@ class DiffBasedKFCVAnomalyDetector(DiffBasedAnomalyDetector):
         self.feature_thresholds_ = self._calculate_feature_thresholds(y, y_pred)
 
         return cv_output
+
+    def _estimator_offset(self) -> int:
+        """Window offset of the (possibly pipelined) base estimator."""
+        estimator = self.base_estimator
+        steps = getattr(estimator, "steps", None)
+        if steps:
+            estimator = steps[-1][1]
+        return int(getattr(estimator, "output_offset", 0) or 0)
 
     def _calculate_feature_thresholds(self, y_true, y_pred):
         absolute_error = self._absolute_error(y_true, y_pred)

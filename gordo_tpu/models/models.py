@@ -133,6 +133,12 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
     def lookahead(self) -> int:
         return 0
 
+    @property
+    def output_offset(self) -> int:
+        """Rows the model's output is shorter than its input by
+        (= ModelSpec.output_offset, available before a spec is built)."""
+        return max(self.lookback_window - 1 + self.lookahead, 0)
+
     def build_spec(self, n_features: int, n_features_out: int) -> ModelSpec:
         """Architecture for this estimator. Subclasses override
         :meth:`_build_spec`; spec-level estimator kwargs (compute_dtype) are

@@ -150,3 +150,28 @@ def test_sklearn_clone_returns_detector():
     c = clone(det)
     assert isinstance(c, DiffBasedAnomalyDetector)
     assert isinstance(c.base_estimator, Pipeline)
+
+
+def test_kfcv_rejects_windowed_estimator_clearly():
+    """Windowed models can't scatter KFold validation errors per row; the
+    detector must say so up front (the reference fails with a bare numpy
+    broadcast error instead)."""
+    from gordo_tpu import serializer
+
+    model = serializer.from_definition({
+        "gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.models.LSTMAutoEncoder": {
+                    "kind": "lstm_symmetric", "dims": [8], "funcs": ["tanh"],
+                    "lookback_window": 12, "epochs": 1,
+                }
+            },
+        }
+    })
+    X = pd.DataFrame(
+        np.random.RandomState(0).rand(120, 4),
+        index=pd.date_range("2019-01-01", periods=120, freq="10min", tz="UTC"),
+        columns=list("abcd"),
+    )
+    with pytest.raises(ValueError, match="offset-free"):
+        model.cross_validate(X=X, y=X)
