@@ -247,17 +247,41 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         }
         return self
 
+    def _params_on_host(self) -> bool:
+        """True when params are host numpy (artifact load) rather than
+        already-placed jax arrays — the one-time reshard trigger."""
+        leaves = jax.tree_util.tree_leaves(self.params_)
+        return bool(leaves) and not all(
+            isinstance(leaf, jax.Array) for leaf in leaves
+        )
+
     # ------------------------------------------------------------ predict
     def predict(self, X, **kwargs) -> np.ndarray:
         if not hasattr(self, "params_"):
             raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
         X = self._as_2d_array(X)
+        from gordo_tpu.parallel.expert_parallel import ep_degree, shard_params_ep
         from gordo_tpu.parallel.tensor_parallel import maybe_reshard_params, tp_degree
 
         if tp_degree(self.spec_) > 1:
             # artifact-loaded params are host numpy; re-establish the model-
             # mesh sharding before the first jitted predict
             self.params_ = maybe_reshard_params(self.spec_, self.params_)
+        if (
+            ep_degree(self.spec_) > 1
+            and self._params_on_host()
+            and not getattr(self, "_ep_reshard_failed", False)
+        ):
+            # non-strict: a small serving host degrades to all-local expert
+            # dispatch instead of erroring (parallel/expert_parallel.py).
+            # A failed reshard is remembered — params stay host numpy there,
+            # and retrying (plus re-warning) on every predict would tax the
+            # serving hot path for a deterministic outcome
+            resharded = shard_params_ep(self.spec_, self.params_, strict=False)
+            if resharded is self.params_:
+                self._ep_reshard_failed = True
+            else:
+                self.params_ = resharded
         # serving: concurrent predicts across models fuse into one device
         # call when the cross-model batcher is enabled (server/batcher.py)
         from gordo_tpu.server.batcher import maybe_submit

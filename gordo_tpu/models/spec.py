@@ -78,6 +78,11 @@ class MoEBlock:
     activation: str = "relu"
     causal: bool = False
     attention_impl: str = "auto"
+    # Switch load-balancing auxiliary loss weight (Fedus et al. §2.2:
+    # num_experts * sum_e fraction_routed_e * mean_gate_e). Without it the
+    # top-1 router is prone to expert collapse — one hot expert absorbs all
+    # tokens and the num_experts/expert_parallel capacity trains unused.
+    aux_loss_weight: float = 0.01
 
 
 @dataclass(frozen=True)

@@ -11,7 +11,7 @@ and ``apply_model`` is a pure function of (spec, params, x).
 """
 
 import functools
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -349,12 +349,26 @@ def moe_dispatch_ffn(
     return tok_out * weight[:, None]
 
 
-def _apply_moe_block(layer: MoEBlock, p, x, ffn_fn=None):
+def moe_aux_loss(layer: MoEBlock, gates: jnp.ndarray) -> jnp.ndarray:
+    """Switch load-balancing loss: E * sum_e f_e * P_e (Fedus et al. §2.2),
+    where f_e is the fraction of tokens whose top-1 expert is e and P_e the
+    mean router probability for e. Minimized (= 1) under uniform routing;
+    differentiable through P_e, so the router learns to spread load."""
+    top1 = jnp.argmax(gates, axis=-1)
+    f = jnp.mean(
+        jax.nn.one_hot(top1, layer.num_experts, dtype=jnp.float32), axis=0
+    )
+    p_mean = jnp.mean(gates, axis=0)
+    return layer.num_experts * jnp.sum(f * p_mean)
+
+
+def _apply_moe_block(layer: MoEBlock, p, x, ffn_fn=None, return_aux=False):
     """Pre-LN MoE encoder block. x: (batch, time, d_model).
 
     ``ffn_fn(layer, expert_w, flat, gates)`` overrides the routed-FFN
     execution — expert parallelism passes its shard_map here; attention and
-    routing are identical either way.
+    routing are identical either way. With ``return_aux`` the weighted
+    Switch load-balancing loss rides along for the training penalty.
     """
     x = _attention_sublayer(layer, p, x)
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
@@ -370,7 +384,14 @@ def _apply_moe_block(layer: MoEBlock, p, x, ffn_fn=None):
         )
     else:
         ffn = ffn_fn(layer, expert_w, flat, gates)
-    return x + ffn.reshape(b, t, d)
+    out = x + ffn.reshape(b, t, d)
+    if not return_aux:
+        return out
+    weight = float(getattr(layer, "aux_loss_weight", 0.0) or 0.0)
+    aux = weight * moe_aux_loss(layer, gates) if weight > 0.0 else jnp.asarray(
+        0.0, jnp.float32
+    )
+    return out, aux
 
 
 def _causal_conv1d(x, kernel, dilation: int):
@@ -475,9 +496,15 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
             if int(getattr(spec, "expert_parallel", 0) or 0) > 1:
                 from gordo_tpu.parallel.expert_parallel import apply_ep_moe_block
 
-                out = apply_ep_moe_block(spec, layer, p, out)
+                out, aux = apply_ep_moe_block(
+                    spec, layer, p, out, return_aux=True
+                )
             else:
-                out = _seq_layer(_apply_moe_block, layer, p, out)
+                out, aux = _seq_layer(
+                    functools.partial(_apply_moe_block, return_aux=True),
+                    layer, p, out,
+                )
+            penalty = penalty + aux
         elif isinstance(layer, TCNBlock):
             out = _seq_layer(_apply_tcn_block, layer, p, out)
         elif isinstance(layer, PoolLayer):

@@ -343,12 +343,35 @@ def fit_arrays(
             f"{spec.lookback_window} lookahead={spec.lookahead}"
         )
     batch_size = min(batch_size, max(n_samples, 1))
+    from gordo_tpu.parallel.expert_parallel import ep_degree, shard_params_ep
+    from gordo_tpu.parallel.pipeline_parallel import pp_degree, pp_mesh
     from gordo_tpu.parallel.tensor_parallel import shard_params_tp, tp_degree
 
+    pp = pp_degree(spec)
+    if pp > 1 and batch_size % pp:
+        # the clamp above can break the divisibility fit() validated; a
+        # non-divisible batch would silently run every step on the
+        # sequential fallback, so round down to re-engage the pipe — or
+        # fail loudly when the dataset is smaller than the stage count
+        if batch_size < pp:
+            raise ValueError(
+                f"pipeline_parallel={pp} but only {n_samples} training "
+                f"sample(s); the pipeline needs at least one sample per stage"
+            )
+        batch_size -= batch_size % pp
     if tp_degree(spec) > 1:
         # commit the weights to the `model` mesh; every jitted step below
         # then runs SPMD with XLA-inserted collectives, unchanged
         params = shard_params_tp(spec, params)
+    if pp > 1:
+        # training claims capacity: fail loudly here rather than silently
+        # running every step on the sequential fallback (serving degrades
+        # instead — apply_pipelined_blocks falls back with a warning)
+        pp_mesh(pp)
+    if ep_degree(spec) > 1:
+        # commit expert weights to the `expert` mesh: each chip STORES its
+        # E/N experts; grads and optimizer state inherit the sharding
+        params = shard_params_ep(spec, params)
     epoch_fn = _build_epoch_fn(spec, n_samples, batch_size, shuffle)
 
     opt = make_optimizer(spec.optimizer)

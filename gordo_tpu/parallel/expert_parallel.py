@@ -24,11 +24,14 @@ drift. Like ring/TP/PP, EP specs keep off both vmap paths.
 """
 
 import functools
+import logging
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gordo_tpu.models.spec import ModelSpec, MoEBlock
+
+logger = logging.getLogger(__name__)
 
 AXIS = "expert"
 
@@ -76,6 +79,50 @@ def ep_mesh(n_shards: int) -> Mesh:
     return Mesh(devices[:n_shards], (AXIS,))
 
 
+def ep_shardings(spec: ModelSpec, params, mesh: Mesh):
+    """Per-leaf shardings: expert FFN weights (leading expert axis) shard
+    over the ``expert`` mesh axis; router, attention and every other layer
+    replicate."""
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(AXIS))
+    shardings = jax.tree_util.tree_map(lambda _: repl, params)
+    for i, layer in enumerate(spec.layers):
+        if isinstance(layer, MoEBlock):
+            layer_shardings = dict(shardings[i])
+            for key in ("w1", "b1", "w2", "b2"):
+                layer_shardings[key] = shard
+            shardings[i] = layer_shardings
+    return shardings
+
+
+def shard_params_ep(spec: ModelSpec, params, strict: bool = True):
+    """Commit expert weights to the ``expert`` mesh (no-op when EP is off).
+
+    After this each chip STORES E/N experts — params, grads and optimizer
+    state all inherit the sharding through the jitted step — instead of
+    holding the full pytree and paying a reshard per call.
+
+    ``strict=False`` (serving) degrades to unsharded params when the host
+    has fewer chips than the shard count; the single-device dispatch in
+    :func:`apply_ep_moe_block` then runs all experts locally. Training
+    keeps ``strict=True`` because EP is a capacity claim there.
+    """
+    ep = ep_degree(spec)
+    if ep <= 1:
+        return params
+    try:
+        mesh = ep_mesh(ep)
+    except ValueError as exc:
+        if strict:
+            raise
+        logger.warning(
+            "expert_parallel=%d model degrading to all-local experts: %s",
+            ep, exc,
+        )
+        return params
+    return jax.device_put(params, ep_shardings(spec, params, mesh))
+
+
 @functools.lru_cache(maxsize=32)
 def _ep_ffn_fn(layer: MoEBlock, n_shards: int):
     """shard_map'd routed FFN: expert weights sharded, tokens replicated,
@@ -101,13 +148,26 @@ def _ep_ffn_fn(layer: MoEBlock, n_shards: int):
     )
 
 
-def apply_ep_moe_block(spec: ModelSpec, layer: MoEBlock, p, x):
-    """Apply one MoE block with its experts sharded over the mesh."""
+def apply_ep_moe_block(spec: ModelSpec, layer: MoEBlock, p, x, return_aux=False):
+    """Apply one MoE block with its experts sharded over the mesh.
+
+    Degrades to the single-device all-experts dispatch when this host has
+    fewer chips than the shard count (an EP-trained artifact serving on a
+    small host) — routing math is shared, so outputs are identical."""
     from gordo_tpu.ops.nn import _apply_moe_block
 
-    fn = _ep_ffn_fn(layer, ep_degree(spec))
+    ep = ep_degree(spec)
+    if ep > len(jax.local_devices()):
+        logger.warning(
+            "expert_parallel=%d but only %d addressable device(s); "
+            "dispatching all experts locally",
+            ep, len(jax.local_devices()),
+        )
+        return _apply_moe_block(layer, p, x, return_aux=return_aux)
+
+    fn = _ep_ffn_fn(layer, ep)
 
     def ffn(layer_, expert_w, flat, gates):
         return fn(expert_w, flat, gates)
 
-    return _apply_moe_block(layer, p, x, ffn_fn=ffn)
+    return _apply_moe_block(layer, p, x, ffn_fn=ffn, return_aux=return_aux)

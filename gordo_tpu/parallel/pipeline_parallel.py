@@ -23,17 +23,27 @@ pipelines the Transformer families only — heterogeneous layer runs
 projection, positional encoding, pool, output head) are tiny and run
 replicated outside the pipeline.
 
+Scaling honesty: this axis scales COMPUTE, not parameter HBM. Params and
+optimizer state are stored replicated (the per-layer-dict pytree has no
+persistent stage axis); the stack-and-shard happens per call, so each step
+pays one small relayout. For capacity scaling of weights use
+tensor_parallel (stored NamedShardings) or expert_parallel (expert weights
+stored sharded); the pipeline's win is keeping all chips busy on depth.
+
 Like ring attention and TP, pipelined specs are guarded off the
 vmap-over-machines/models paths: the pipe claims the mesh for one model.
 """
 
 import functools
+import logging
 from dataclasses import replace
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gordo_tpu.models.spec import ModelSpec, TransformerBlock
+
+logger = logging.getLogger(__name__)
 
 AXIS = "pipe"
 
@@ -208,8 +218,10 @@ def apply_pipelined_blocks(spec: ModelSpec, layer: TransformerBlock,
     """Run a spec's contiguous TransformerBlock run through the pipeline.
 
     Falls back to the sequential loop when the batch cannot be cut into
-    the stage count's microbatches (e.g. odd predict remainders) — the
-    math is identical either way, only the schedule changes.
+    the stage count's microbatches (e.g. odd predict remainders) or when
+    this host has fewer chips than the stage count (a PP-trained artifact
+    serving on a small host) — the math is identical either way, only the
+    schedule changes.
     """
     from gordo_tpu.ops.nn import _apply_transformer_block
 
@@ -217,7 +229,14 @@ def apply_pipelined_blocks(spec: ModelSpec, layer: TransformerBlock,
     remat = bool(getattr(spec, "remat", False))
     n_blocks = len(block_params)
     n_micro = pp  # M = S keeps the bubble at 50% worst case, 0 host knobs
-    if x.shape[0] % n_micro:
+    mesh_available = pp <= len(jax.local_devices())
+    if not mesh_available:
+        logger.warning(
+            "pipeline_parallel=%d but only %d addressable device(s); "
+            "running the sequential block loop",
+            pp, len(jax.local_devices()),
+        )
+    if not mesh_available or x.shape[0] % n_micro:
         for p in block_params:
             apply = functools.partial(_apply_transformer_block, layer)
             if remat:
