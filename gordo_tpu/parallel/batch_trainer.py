@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
-from sklearn.model_selection import TimeSeriesSplit
+from sklearn.model_selection import KFold, TimeSeriesSplit
 from sklearn.pipeline import Pipeline
 from sklearn.preprocessing import MinMaxScaler
 
@@ -95,6 +95,8 @@ class _Plan:
     batch_size: int = 32
     shuffle: bool = True
     n_splits: int = 3
+    # fold geometry: ("tss", n_splits) or ("kfold", n_splits, shuffle, seed)
+    cv: Tuple = ("tss", 3)
     # filled during data load
     X: Optional[np.ndarray] = None
     y: Optional[np.ndarray] = None
@@ -113,6 +115,7 @@ class _Plan:
             self.shuffle,
             self.scale_x,
             self.n_splits,
+            self.cv,
         )
 
 
@@ -145,10 +148,14 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         }
         if kfcv:
             # under the builder the fold geometry comes from evaluation.cv
-            # (TimeSeriesSplit(3) by default) even for the KFCV detector, so
-            # the same contiguous-fold program applies; only the threshold
-            # assembly (percentile of the smoothed validation-error series)
-            # differs. The detector-level pre-fit shuffle is subsumed by the
+            # (TimeSeriesSplit(3) by default — both builders pass cv= into
+            # the detector, overriding its standalone KFold(5) default:
+            # reference build_model.py:233-243) even for the KFCV detector,
+            # so the contiguous-fold program applies; a configured seeded
+            # KFold instead runs through per-stage permutations (see the
+            # cv-config block below). Only the threshold assembly
+            # (percentile of the smoothed validation-error series) differs.
+            # The detector-level pre-fit shuffle is subsumed by the
             # in-program batch shuffling — an RNG-stream difference, like the
             # batched path's seeds (module docstring).
             if type(model) is not DiffBasedKFCVAnomalyDetector:
@@ -186,25 +193,46 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
     if inner.lookahead is None:
         return None
 
-    # CV config: only (default) TimeSeriesSplit is batchable
+    # CV config: TimeSeriesSplit is batchable for every plan; a seeded
+    # KFold additionally for KFCV plans — the KFCV scatter-percentile
+    # threshold math is well-defined for arbitrary fold index sets (the
+    # per-fold permutation runs inside the bucket program), while the plain
+    # detector's rolling-window thresholds need contiguous folds
     n_splits = 3
+    cv_desc: Tuple = ("tss", 3)
     cv_cfg = machine.evaluation.get("cv")
     if cv_cfg is not None:
         try:
             cv_obj = serializer.from_definition(cv_cfg)
         except Exception:
             return None
-        if not isinstance(cv_obj, TimeSeriesSplit):
+        if isinstance(cv_obj, TimeSeriesSplit):
+            # non-default gap/test_size/max_train_size change fold geometry
+            # in ways _fold_bounds does not model — those configs stay serial
+            if (
+                getattr(cv_obj, "gap", 0) != 0
+                or getattr(cv_obj, "test_size", None) is not None
+                or getattr(cv_obj, "max_train_size", None) is not None
+            ):
+                return None
+            n_splits = cv_obj.n_splits
+            cv_desc = ("tss", n_splits)
+        elif isinstance(cv_obj, KFold) and kfcv:
+            shuffle_cv = bool(getattr(cv_obj, "shuffle", False))
+            seed_cv = getattr(cv_obj, "random_state", None)
+            if shuffle_cv and not isinstance(seed_cv, (int, np.integer)):
+                # unseeded shuffled folds are irreproducible — the serial
+                # path would even disagree with its own split metadata
+                return None
+            n_splits = cv_obj.n_splits
+            cv_desc = (
+                "kfold",
+                n_splits,
+                shuffle_cv,
+                int(seed_cv) if seed_cv is not None else None,
+            )
+        else:
             return None
-        # non-default gap/test_size/max_train_size change fold geometry in
-        # ways _fold_bounds does not model — those configs stay serial
-        if (
-            getattr(cv_obj, "gap", 0) != 0
-            or getattr(cv_obj, "test_size", None) is not None
-            or getattr(cv_obj, "max_train_size", None) is not None
-        ):
-            return None
-        n_splits = cv_obj.n_splits
 
     fit_args = inner.extract_supported_fit_args(inner.kwargs)
     if fit_args.get("callbacks") or fit_args.get("validation_split"):
@@ -249,6 +277,7 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         batch_size=int(fit_args.get("batch_size", 32)),
         shuffle=bool(fit_args.get("shuffle", True)),
         n_splits=n_splits,
+        cv=cv_desc,
     )
 
 
@@ -288,6 +317,7 @@ def _bucket_program(
     shuffle: bool,
     scale_x: bool,
     out_sharding=None,
+    use_perms: bool = False,
 ):
     """
     Compile the full per-machine build for one bucket:
@@ -304,6 +334,14 @@ def _bucket_program(
     compile time was ~40% of a cold fleet build and scaled with the fold
     count before this.
 
+    ``use_perms``: the program takes a fourth, non-vmapped argument
+    ``perms`` of shape (n_folds+1, n_rows) — a per-stage row permutation
+    applied to X/y before training (one gather). This is how seeded
+    shuffled-KFold geometry runs through the same contiguous-fold machinery:
+    each stage's permutation is [train_idx..., test_idx...], so "train
+    prefix" and "test tail slice" stay static shapes. The final stage's
+    permutation must be the identity.
+
     ``out_sharding``: force every output's machine axis onto this sharding.
     Required in multi-process mode, where each host reads back only its
     addressable rows — XLA must not replicate outputs.
@@ -311,7 +349,8 @@ def _bucket_program(
     te_lens = {te_end - te_start for _, te_start, te_end in fold_bounds}
     if len(te_lens) != 1:
         # non-uniform test slices can't share one predict shape; rare
-        # (TimeSeriesSplit always yields equal test sizes)
+        # (TimeSeriesSplit always yields equal test sizes, and the KFold
+        # planner pads bounds to the max fold size)
         return _bucket_program_unrolled(
             spec, n_rows, fold_bounds, epochs, batch_size, shuffle, scale_x,
             out_sharding,
@@ -331,28 +370,33 @@ def _bucket_program(
     )
     te_starts = np.array([te_start for _, te_start, _ in fold_bounds] + [0])
 
-    def one_machine(X, y, seed):
+    def one_machine(X, y, seed, perms=None):
         rng = jax.random.fold_in(jax.random.PRNGKey(0), seed)
 
         def stage(_, inp):
-            k, tr_row, n_valid, te_start = inp
+            if use_perms:
+                k, tr_row, n_valid, te_start, perm = inp
+                Xk, yk = X[perm], y[perm]
+            else:
+                k, tr_row, n_valid, te_start = inp
+                Xk, yk = X, y
             k_init, k_fit = jax.random.split(jax.random.fold_in(rng, k))
             if scale_x:
                 in_train = (jnp.arange(n_rows) < tr_row)[:, None]
-                mn = jnp.min(jnp.where(in_train, X, jnp.inf), axis=0)
-                mx = jnp.max(jnp.where(in_train, X, -jnp.inf), axis=0)
+                mn = jnp.min(jnp.where(in_train, Xk, jnp.inf), axis=0)
+                mx = jnp.max(jnp.where(in_train, Xk, -jnp.inf), axis=0)
                 span = mx - mn
-                tiny = 10 * jnp.finfo(X.dtype).eps
+                tiny = 10 * jnp.finfo(Xk.dtype).eps
                 scale = 1.0 / jnp.where(span < tiny, 1.0, span)
-                Xs = (X - mn) * scale
+                Xs = (Xk - mn) * scale
             else:
-                Xs = X
+                Xs = Xk
             params = init_model_params(k_init, spec)
             opt_state = opt.init(params)
 
             def epoch_body(carry, epoch_rng):
                 p, o = carry
-                p, o, loss = epoch_fn(p, o, Xs, y, epoch_rng, n_valid)
+                p, o, loss = epoch_fn(p, o, Xs, yk, epoch_rng, n_valid)
                 return (p, o), loss
 
             (params, _), losses = jax.lax.scan(
@@ -368,12 +412,17 @@ def _bucket_program(
             jnp.asarray(n_valids),
             jnp.asarray(te_starts),
         )
+        if use_perms:
+            stages = stages + (perms,)
         _, (params_all, losses_all, preds_all) = jax.lax.scan(stage, None, stages)
         p_final = jax.tree_util.tree_map(lambda a: a[-1], params_all)
         # tuple-of-folds output keeps the same contract as the unrolled path
         return p_final, losses_all[-1], tuple(preds_all[k] for k in range(n_folds))
 
-    batched = jax.vmap(one_machine)
+    if use_perms:
+        batched = jax.vmap(one_machine, in_axes=(0, 0, 0, None))
+    else:
+        batched = jax.vmap(one_machine)
     if out_sharding is not None:
         return jax.jit(batched, out_shardings=out_sharding)
     return jax.jit(batched)
@@ -576,11 +625,12 @@ class BatchedModelBuilder:
         serial: List[int] = []
 
         # resume prefilter. Registry lookups (cheap) run threaded for the
-        # whole fleet; every process sees the same hit set, and each hit is
-        # OWNED by exactly one process (serial-machine round-robin with its
-        # own counter) — the owner unpickles and returns it, the others skip
-        # it entirely. Without ownership every host would return, report,
-        # and re-persist the whole cached fleet.
+        # whole fleet, and each hit is OWNED by exactly one process — keyed
+        # by the machine's GLOBAL index, not its position in the locally
+        # observed hit list: registries can drift between processes
+        # (overlapping builds registering keys mid-prefilter), and
+        # position-keyed ownership would then double- or zero-own a machine.
+        # The owner unpickles and returns it, the others skip it entirely.
         cached_results: Dict[int, Tuple[Any, Machine]] = {}
         foreign_cached: set = set()
         if self.model_register_dir and self.machines:
@@ -590,10 +640,12 @@ class BatchedModelBuilder:
                     pool.map(lambda i: self._cached_path(self.machines[i]), idxs)
                 )
             owned_hits = []
-            for ordinal, (i, path) in enumerate(
-                (i, p) for i, p in zip(idxs, paths) if p
-            ):
-                if distributed.owns_serial_machine(ordinal):
+            for i, path in zip(idxs, paths):
+                if not path:
+                    continue
+                if distributed.owns_serial_machine(
+                    _machine_seed(self.machines[i])
+                ):
                     owned_hits.append((i, path))
                 else:
                     foreign_cached.add(i)
@@ -627,13 +679,20 @@ class BatchedModelBuilder:
             else:
                 plans[i] = plan
 
-        for ordinal, i in enumerate(serial):
+        # ownership keyed by a stable hash of the machine name (same rule as
+        # the cached-hit loop above): the serial list's composition depends
+        # on local cache state, so list-POSITION ownership could diverge
+        # between processes, while raw global indices could concentrate load
+        # on one process when unbatchable machines land on a stride
+        for i in serial:
             if not self.serial_fallback:
                 raise ValueError(
                     f"Machine {self.machines[i].name} is not batchable and "
                     f"serial_fallback=False"
                 )
-            if not distributed.owns_serial_machine(ordinal):
+            if not distributed.owns_serial_machine(
+                _machine_seed(self.machines[i])
+            ):
                 continue
             logger.info("Machine %s: serial fallback", self.machines[i].name)
             results[i] = ModelBuilder(self.machines[i]).build(
@@ -671,7 +730,33 @@ class BatchedModelBuilder:
         plan0 = bucket[0]
         spec = plan0.spec
         n_rows = len(plan0.X)
-        fold_bounds = self._fold_bounds(n_rows, plan0.n_splits)
+        kfold_folds: Optional[List[Tuple[np.ndarray, np.ndarray]]] = None
+        perms: Optional[np.ndarray] = None
+        if plan0.cv[0] == "kfold":
+            # seeded shuffled-KFold geometry (KFCV plans): exact sklearn fold
+            # assignment computed on host — identical to the serial
+            # detector's — expressed as per-stage row permutations
+            # [train..., test...] so the program keeps static train-prefix /
+            # test-tail shapes. Bounds pad every fold's test slice to the
+            # largest fold; assembly discards the padded leading rows.
+            _, n_sp, shuffle_cv, seed_cv = plan0.cv
+            splitter = KFold(
+                n_splits=n_sp, shuffle=shuffle_cv,
+                random_state=seed_cv if shuffle_cv else None,
+            )
+            kfold_folds = [
+                (tr, te) for tr, te in splitter.split(np.zeros((n_rows, 1)))
+            ]
+            te_max = max(len(te) for _, te in kfold_folds)
+            fold_bounds = tuple(
+                (len(tr), n_rows - te_max, n_rows) for tr, _ in kfold_folds
+            )
+            perms = np.stack(
+                [np.concatenate([tr, te]) for tr, te in kfold_folds]
+                + [np.arange(n_rows)]
+            ).astype(np.int32)
+        else:
+            fold_bounds = self._fold_bounds(n_rows, plan0.n_splits)
         n_dev = int(np.prod(list(self.mesh.shape.values())))
 
         # every CV fold must yield at least one training sample, mirroring the
@@ -703,7 +788,17 @@ class BatchedModelBuilder:
             plan0.shuffle,
             plan0.scale_x,
             out_sharding=sharding if multiprocess else None,
+            use_perms=perms is not None,
         )
+        perms_d = None
+        if perms is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # fold permutations are identical for every machine (same seed,
+            # same row count): one replicated array, not a vmapped axis
+            perms_d = jax.device_put(
+                perms, NamedSharding(self.mesh, PartitionSpec())
+            )
 
         t0 = time.time()
 
@@ -723,6 +818,8 @@ class BatchedModelBuilder:
             X_d = distributed.make_global_stacked(sharding, X)
             y_d = distributed.make_global_stacked(sharding, y)
             seeds_d = distributed.make_global_stacked(sharding, seeds)
+            if perms_d is not None:
+                return group, program(X_d, y_d, seeds_d, perms_d)
             return group, program(X_d, y_d, seeds_d)
 
         def fetch(group, outputs):
@@ -771,7 +868,8 @@ class BatchedModelBuilder:
                         lambda idx, plan, p, l, fp: (
                             idx,
                             self._assemble_and_persist(
-                                plan, p, l, fp, fold_bounds, per_machine_est
+                                plan, p, l, fp, fold_bounds, per_machine_est,
+                                kfold_folds,
                             ),
                         ),
                         global_idxs[chunk_start + row],
@@ -828,13 +926,14 @@ class BatchedModelBuilder:
     # --------------------------------------------------------- assembly
     def _assemble_and_persist(
         self, plan: _Plan, params, losses, fold_preds, fold_bounds,
-        per_machine_est: float,
+        per_machine_est: float, kfold_folds=None,
     ) -> Tuple[Any, Machine]:
         n_stages = len(fold_bounds) + 1
         built = self._assemble(
             plan, params, losses, fold_preds, fold_bounds,
             per_machine_est / n_stages,
             per_machine_est * len(fold_bounds) / n_stages,
+            kfold_folds,
         )
         self._persist(plan.machine, *built)
         return built
@@ -848,6 +947,7 @@ class BatchedModelBuilder:
         fold_bounds,
         train_duration: float,
         cv_duration: float,
+        kfold_folds=None,
     ) -> Tuple[Any, Machine]:
         machine = plan.machine
         X, y, index = plan.X, plan.y, plan.index
@@ -881,13 +981,15 @@ class BatchedModelBuilder:
             )
             detector.scaler.fit(y)
             if plan.kfcv:
-                self._set_kfcv_thresholds(detector, plan, fold_preds, fold_bounds)
+                self._set_kfcv_thresholds(
+                    detector, plan, fold_preds, fold_bounds, kfold_folds
+                )
             else:
                 self._set_thresholds(detector, plan, fold_preds, fold_bounds)
             model = detector
 
-        scores = self._fold_scores(plan, fold_preds, fold_bounds)
-        splits = self._split_metadata(index, fold_bounds)
+        scores = self._fold_scores(plan, fold_preds, fold_bounds, kfold_folds)
+        splits = self._split_metadata(index, fold_bounds, kfold_folds)
 
         machine_out = Machine(
             name=machine.name,
@@ -1005,7 +1107,9 @@ class BatchedModelBuilder:
         detector.smooth_aggregate_threshold_ = smooth_agg
         detector.smooth_feature_thresholds_ = smooth_tag
 
-    def _set_kfcv_thresholds(self, detector, plan, fold_preds, fold_bounds):
+    def _set_kfcv_thresholds(
+        self, detector, plan, fold_preds, fold_bounds, kfold_folds=None
+    ):
         """Percentile thresholds from the in-program fold predictions.
 
         Serial parity (DiffBasedKFCVAnomalyDetector.cross_validate, reference
@@ -1015,10 +1119,33 @@ class BatchedModelBuilder:
         then smooth with the detector's configured method and take its
         percentile. The per-fold mse scaling uses the fold model's y-scaler
         stats, i.e. min/max of that fold's train targets.
+
+        With ``kfold_folds`` (seeded-KFold geometry) the scatter targets are
+        each fold's test index array and the scaler stats come from its
+        train index array; the fold predictions were computed over a
+        padded test tail, so only the last ``len(test_idx)`` rows are real.
         """
         y = plan.y
         y_pred = np.zeros_like(y)
         val_mse = np.full(len(y), np.nan, dtype=y.dtype)
+        if kfold_folds is not None:
+            for (train_idx, test_idx), pred_padded in zip(kfold_folds, fold_preds):
+                pred = pred_padded[-len(test_idx):]
+                y_true = y[test_idx]
+                train_y = y[train_idx]
+                mn = train_y.min(axis=0)
+                rng = train_y.max(axis=0) - mn
+                tiny = 10 * np.finfo(rng.dtype).eps
+                scale = 1.0 / np.where(rng < tiny, 1.0, rng)
+                y_pred[test_idx] = pred
+                val_mse[test_idx] = (((pred - y_true) * scale) ** 2).mean(axis=1)
+            detector.aggregate_threshold_ = float(
+                detector._calculate_threshold(pd.Series(val_mse))
+            )
+            detector.feature_thresholds_ = detector._calculate_threshold(
+                pd.DataFrame(np.abs(y - y_pred))
+            )
+            return
         for (tr_end, te_start, te_end), pred in zip(fold_bounds, fold_preds):
             y_true = y[te_start:te_end]
             train_y = y[:tr_end]
@@ -1036,7 +1163,9 @@ class BatchedModelBuilder:
             pd.DataFrame(np.abs(y - y_pred))
         )
 
-    def _fold_scores(self, plan, fold_preds, fold_bounds) -> Dict[str, Any]:
+    def _fold_scores(
+        self, plan, fold_preds, fold_bounds, kfold_folds=None
+    ) -> Dict[str, Any]:
         """Per-tag + aggregate fold scores, matching the serial builder's
         scorer names/shape (build_model.py:351-420)."""
         evaluation = plan.machine.evaluation
@@ -1066,8 +1195,19 @@ class BatchedModelBuilder:
         per_metric_fold_cols: Dict[str, List[np.ndarray]] = {m: [] for m in metric_names}
         per_metric_fold_agg: Dict[str, List[float]] = {m: [] for m in metric_names}
 
-        for (tr_end, te_start, te_end), y_pred in zip(fold_bounds, fold_preds):
-            y_true = plan.y[te_start + offset : te_end]
+        if kfold_folds is not None:
+            fold_pairs = [
+                (plan.y[test_idx], pred_padded[-len(test_idx):])
+                for (_, test_idx), pred_padded in zip(kfold_folds, fold_preds)
+            ]
+        else:
+            fold_pairs = [
+                (plan.y[te_start + offset : te_end], y_pred)
+                for (tr_end, te_start, te_end), y_pred in zip(
+                    fold_bounds, fold_preds
+                )
+            ]
+        for y_true, y_pred in fold_pairs:
             yt, yp = y_true, y_pred
             if scaler is not None:
                 yt = scaler.transform(yt)
@@ -1102,8 +1242,18 @@ class BatchedModelBuilder:
             scores[metric_str] = entry
         return scores
 
-    def _split_metadata(self, index, fold_bounds) -> Dict[str, Any]:
+    def _split_metadata(self, index, fold_bounds, kfold_folds=None) -> Dict[str, Any]:
         splits: Dict[str, Any] = {}
+        if kfold_folds is not None:
+            # mirror the serial builder's build_split_dict keys exactly
+            # (builder/build_model.py) — shuffled folds have no contiguous
+            # date range; first/last visited rows are what it records
+            for k, (train_rows, test_rows) in enumerate(kfold_folds, start=1):
+                for part, rows in (("train", train_rows), ("test", test_rows)):
+                    splits[f"fold-{k}-{part}-start"] = index[rows[0]]
+                    splits[f"fold-{k}-{part}-end"] = index[rows[-1]]
+                    splits[f"fold-{k}-n-{part}"] = len(rows)
+            return splits
         for k, (tr_end, te_start, te_end) in enumerate(fold_bounds):
             splits.update(
                 {

@@ -416,3 +416,132 @@ def test_profile_dir_captures_device_trace(tmp_path, monkeypatch):
         for f in fs
     ]
     assert files, "profiler produced no trace files"
+
+
+# --------------------------------------------------- seeded-KFold KFCV plans
+def _kfold_kfcv_block(name, n_splits=5, window=12):
+    block = _kfcv_block(name, window=window)
+    return block + f"""    evaluation:
+      cv:
+        sklearn.model_selection.KFold:
+          n_splits: {n_splits}
+          shuffle: true
+          random_state: 0
+"""
+
+
+def test_kfold_kfcv_machines_take_batched_path():
+    from gordo_tpu.parallel.batch_trainer import _plan_machine
+
+    machines = _machines("machines:" + _kfold_kfcv_block("kfold-0"))
+    plan = _plan_machine(machines[0])
+    assert plan is not None and plan.kfcv
+    assert plan.cv == ("kfold", 5, True, 0)
+
+
+def test_kfold_cv_stays_serial_outside_kfcv():
+    """Shuffled folds break the plain detector's rolling-threshold math and
+    unseeded shuffles are irreproducible — both stay on the serial path."""
+    from gordo_tpu.parallel.batch_trainer import _plan_machine
+
+    plain = _machines("machines:" + _machine_block("plain-kf"))
+    plain[0].evaluation["cv"] = {
+        "sklearn.model_selection.KFold": {
+            "n_splits": 5, "shuffle": True, "random_state": 0,
+        }
+    }
+    assert _plan_machine(plain[0]) is None
+
+    unseeded = _machines("machines:" + _kfcv_block("unseeded-kf"))
+    unseeded[0].evaluation["cv"] = {
+        "sklearn.model_selection.KFold": {"n_splits": 5, "shuffle": True}
+    }
+    assert _plan_machine(unseeded[0]) is None
+
+
+def test_kfold_kfcv_threshold_math_matches_serial():
+    """With seeded-KFold geometry (uneven fold sizes ⇒ padded test slices),
+    _set_kfcv_thresholds must reproduce the serial KFCV detector's
+    percentile thresholds exactly, given the same fold predictions."""
+    from types import SimpleNamespace
+
+    from sklearn.linear_model import LinearRegression
+    from sklearn.model_selection import KFold
+    from sklearn.preprocessing import MinMaxScaler
+
+    from gordo_tpu.models.anomaly.diff import DiffBasedKFCVAnomalyDetector
+
+    rng = np.random.RandomState(11)
+    n_rows = 302  # 302 % 5 != 0: folds of 61/61/60/60/60 exercise padding
+    X = rng.rand(n_rows, 4)
+    y = X @ rng.rand(4, 4) + 0.01 * rng.rand(n_rows, 4)
+    cv = KFold(n_splits=5, shuffle=True, random_state=0)
+
+    serial = DiffBasedKFCVAnomalyDetector(
+        base_estimator=LinearRegression(),
+        scaler=MinMaxScaler(),
+        window=24,
+        shuffle=False,
+    )
+    serial.cross_validate(X=pd.DataFrame(X), y=pd.DataFrame(y), cv=cv)
+
+    folds = [(tr, te) for tr, te in cv.split(X)]
+    te_max = max(len(te) for _, te in folds)
+    fold_bounds = [(len(tr), n_rows - te_max, n_rows) for tr, _ in folds]
+    fold_preds = []
+    for tr, te in folds:
+        lr = LinearRegression().fit(X[tr], y[tr])
+        pred = lr.predict(X[te])
+        pad = te_max - len(te)
+        if pad:
+            # the program's padded test tail starts with train rows whose
+            # predictions the assembly must discard
+            pred = np.vstack([np.full((pad, y.shape[1]), 1e6), pred])
+        fold_preds.append(pred)
+
+    batched = DiffBasedKFCVAnomalyDetector(
+        base_estimator=LinearRegression(),
+        scaler=MinMaxScaler(),
+        window=24,
+        shuffle=False,
+    )
+    BatchedModelBuilder._set_kfcv_thresholds(
+        None, batched, SimpleNamespace(y=y), fold_preds, fold_bounds, folds
+    )
+    np.testing.assert_allclose(
+        batched.aggregate_threshold_, serial.aggregate_threshold_, rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched.feature_thresholds_),
+        np.asarray(serial.feature_thresholds_),
+        rtol=1e-9,
+    )
+
+
+def test_kfold_kfcv_batched_build_matches_serial_builder():
+    """End to end: a seeded-KFold KFCV machine built batched vs the serial
+    ModelBuilder. Fold geometry (splits metadata) must match EXACTLY; the
+    thresholds come from independently-initialized trainings, so they match
+    statistically (same order of magnitude), not bit-for-bit."""
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.models.anomaly.diff import DiffBasedKFCVAnomalyDetector
+
+    cfg = "machines:" + _kfold_kfcv_block("kfold-e2e")
+    [(batched_model, batched_out)] = BatchedModelBuilder(
+        _machines(cfg), serial_fallback=False
+    ).build()
+    serial_model, serial_out = ModelBuilder(_machines(cfg)[0]).build()
+    assert isinstance(batched_model, DiffBasedKFCVAnomalyDetector)
+
+    b_splits = batched_out.metadata.build_metadata.model.cross_validation.splits
+    s_splits = serial_out.metadata.build_metadata.model.cross_validation.splits
+    assert set(b_splits) == set(s_splits)
+    for key in s_splits:
+        assert str(b_splits[key]) == str(s_splits[key]), key
+
+    ratio = batched_model.aggregate_threshold_ / serial_model.aggregate_threshold_
+    assert 1 / 3 < ratio < 3, ratio
+    feat_ratio = np.asarray(batched_model.feature_thresholds_) / np.asarray(
+        serial_model.feature_thresholds_
+    )
+    assert np.all((feat_ratio > 1 / 3) & (feat_ratio < 3)), feat_ratio
