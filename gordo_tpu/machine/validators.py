@@ -9,7 +9,6 @@ timezone-aware datetimes, machine-runtime resource fix-ups.
 import logging
 import re
 from datetime import datetime
-from typing import Optional
 
 logger = logging.getLogger(__name__)
 
@@ -133,11 +132,16 @@ def fix_resource_limits(resources: dict) -> dict:
 
 
 class ValidMachineRuntime(BaseDescriptor):
-    """Runtime dict; resource requests/limits are fixed up on set."""
+    """Runtime dict: typed-schema validation of pod fragments
+    (env/volumes/mounts/resources — workflow/schemas.py, the reference's
+    config_elements/schemas.py:5-66 contract), then resource fix-ups."""
 
     def __set__(self, instance, value):
         if not isinstance(value, dict):
             raise ValueError(f"{self.name} must be a dict")
+        from gordo_tpu.workflow.schemas import validate_runtime
+
+        validate_runtime(value, self.name)
         for section in ("builder", "server"):
             if section in value and isinstance(value[section], dict):
                 if "resources" in value[section]:

@@ -1,0 +1,150 @@
+"""Typed runtime-schema enforcement at config load (workflow/schemas.py;
+reference contract: gordo/workflow/config_elements/schemas.py:5-66 enforced
+at normalized_config.py:147-159)."""
+
+import pytest
+import yaml
+
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+from gordo_tpu.workflow.schemas import RuntimeConfigError, validate_runtime
+
+
+def _config(runtime_yaml: str) -> dict:
+    return yaml.safe_load(
+        f"""
+globals:
+  runtime:
+{runtime_yaml}
+machines:
+  - name: m-0
+    dataset:
+      tags: [t0, t1]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      data_provider: {{type: RandomDataProvider}}
+    model:
+      gordo_tpu.models.models.AutoEncoder:
+        kind: feedforward_hourglass
+"""
+    )
+
+
+def test_valid_runtime_fragments_load():
+    cfg = _config(
+        """
+    builder:
+      resources:
+        requests: {memory: 1000, cpu: 500}
+        limits: {memory: 2000}
+      env:
+        - name: PLAIN
+          value: "1"
+        - name: FROM_SECRET
+          valueFrom:
+            secretKeyRef: {name: creds, key: token}
+      volumeMounts:
+        - name: data
+          mountPath: /gordo/data
+          readOnly: true
+    volumes:
+      - name: data
+        csi: {driver: secrets-store.csi.k8s.io}
+      - name: scratch
+        emptyDir: {}
+"""
+    )
+    machines = NormalizedConfig(cfg, project_name="p").machines
+    assert machines[0].runtime["builder"]["env"][0]["name"] == "PLAIN"
+    # the non-csi volume source passes through intact (the reference would
+    # silently drop it, schemas.py:41-44)
+    assert machines[0].runtime["volumes"][1]["emptyDir"] == {}
+
+
+@pytest.mark.parametrize(
+    "runtime_yaml, match",
+    [
+        # typo'd mount key — the reference's pydantic v1 ignores it silently
+        (
+            """
+    builder:
+      volumeMounts:
+        - name: data
+          mountPth: /gordo/data
+""",
+            "unknown key",
+        ),
+        (
+            """
+    builder:
+      volumeMounts:
+        - name: data
+""",
+            "missing required",
+        ),
+        (
+            """
+    builder:
+      volumeMounts:
+        - name: data
+          mountPath: relative/path
+""",
+            "absolute",
+        ),
+        (
+            """
+    builder:
+      env:
+        - value: no-name
+""",
+            "missing required",
+        ),
+        (
+            """
+    volumes:
+      - csi: {driver: d}
+""",
+            "name",
+        ),
+        (
+            """
+    volumes:
+      - name: two-sources
+        csi: {driver: d}
+        emptyDir: {}
+""",
+            "exactly one volume source",
+        ),
+        (
+            """
+    server:
+      resources:
+        requests:
+          memory: {oops: mapping}
+""",
+            "quantity",
+        ),
+    ],
+)
+def test_malformed_runtime_fails_config_load(runtime_yaml, match):
+    with pytest.raises((RuntimeConfigError, ValueError), match=match):
+        NormalizedConfig(_config(runtime_yaml), project_name="p")
+
+
+def test_machine_level_runtime_also_validated():
+    cfg = _config("    influx: {enable: true}")
+    cfg["machines"][0]["runtime"] = {
+        "builder": {"volumeMounts": [{"name": "v", "mountPth": "/x"}]}
+    }
+    with pytest.raises((RuntimeConfigError, ValueError), match="unknown key"):
+        NormalizedConfig(cfg, project_name="p")
+
+
+def test_validate_runtime_accepts_none_and_empty():
+    assert validate_runtime(None) == {}
+    assert validate_runtime({}) == {}
+
+
+def test_tpu_chip_resource_quantities_pass():
+    validate_runtime(
+        {"builder": {"resources": {"limits": {"google.com/tpu": 8}}}}
+    )
