@@ -293,11 +293,32 @@ def _torch_windowed_sec_per_machine(family: str, n_rows: int = 1008) -> float:
     return time.time() - t_start
 
 
+def _windowed_spec(family: str):
+    """The ModelSpec a windowed-family machine trains (for FLOPs/MFU)."""
+    import importlib
+
+    cls, kind_kwargs = _WINDOWED_FAMILIES[family]
+    mod, clsname = cls.rsplit(".", 1)
+    est = getattr(importlib.import_module(mod), clsname)(
+        **{
+            **kind_kwargs,
+            "lookback_window": LOOKBACK,
+            "compute_dtype": WINDOWED_DTYPE,
+        }
+    )
+    return est.build_spec(WINDOWED_TAGS, WINDOWED_TAGS)
+
+
 def _bench_windowed() -> dict:
-    """Batched machines/min + torch-CPU denominator per windowed family."""
+    """Batched machines/min + torch-CPU denominator + MFU per windowed
+    family."""
+    import jax
+
     from gordo_tpu.machine import Machine
+    from gordo_tpu.ops import flops as flops_mod
     from gordo_tpu.parallel import BatchedModelBuilder
 
+    device_kind = jax.devices()[0].device_kind
     out = {}
     for family in _WINDOWED_FAMILIES:
         slug = family.replace("_", "-")
@@ -319,7 +340,15 @@ def _bench_windowed() -> dict:
         wall = time.time() - t0
         assert len(results) == N_WINDOWED
         torch_sec = _torch_windowed_sec_per_machine(family)
+        machine_flops = flops_mod.cv_build_flops(
+            _windowed_spec(family), n_rows=1008, epochs=WINDOWED_EPOCHS
+        )
+        mfu_val = flops_mod.mfu(
+            machine_flops * N_WINDOWED, wall, device_kind, len(jax.devices())
+        )
         out[family] = {
+            "flops_per_machine": machine_flops,
+            "mfu": round(mfu_val, 5) if mfu_val is not None else None,
             "n_machines": N_WINDOWED,
             "lookback": LOOKBACK,
             "n_tags": WINDOWED_TAGS,
@@ -458,10 +487,11 @@ def _run_section(name: str) -> dict:
 
 
 def _setup_backend(argv) -> None:
-    """Shared preamble for main() and section children: persistent compile
-    cache, backend liveness probe with clean-env CPU re-exec when the
-    accelerator tunnel is wedged, and CPU-scale shrinking of the
-    accelerator-bound sections.
+    """Preamble for section children (the parent orchestrator never touches
+    jax): persistent compile cache, backend liveness probe with retries —
+    a tunnel that recovers between sections gets used — and clean-env CPU
+    re-exec when the accelerator stays wedged, plus CPU-scale shrinking of
+    the accelerator-bound sections.
 
     Persistent cache is partitioned by platform — a remote-compiled TPU
     artifact must never be offered to a CPU-fallback run on a host with
@@ -479,29 +509,43 @@ def _setup_backend(argv) -> None:
     except Exception:
         pass
 
-    probe_timeout = int(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "180"))
-    if not _default_backend_alive(probe_timeout):
-        print(
-            f"# default backend unreachable within {probe_timeout}s; "
-            "falling back to CPU",
-            file=sys.stderr,
-        )
-        if os.environ.get("GORDO_TPU_BENCH_REEXEC") != "1":
-            # a wedged accelerator plugin blocks even the CPU platform
-            # in-process (plugin init runs at first device op), so the CPU
-            # fallback must be a clean interpreter without the plugin's
-            # site hook on PYTHONPATH (bench.py re-inserts its own dir on
-            # sys.path at startup)
-            env = dict(os.environ)
-            env["GORDO_TPU_BENCH_REEXEC"] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
-            env["PYTHONPATH"] = ""
-            os.execve(sys.executable, [sys.executable, __file__, *argv[1:]], env)
-        jax.config.update("jax_platforms", "cpu")
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    # round-3 postmortem: ONE failed 180s probe surrendered the whole run to
+    # CPU. Retry with backoff before giving up — a flaky tunnel usually
+    # comes back within minutes, and each section child re-runs this probe
+    # independently so a mid-run recovery is picked up. An EXPLICIT
+    # JAX_PLATFORMS=cpu run (tests, CI) skips probing entirely — a wedged
+    # accelerator plugin blocks even the CPU platform until the clean
+    # re-exec below sheds its site hook, so probing would just burn the
+    # full retry budget before reaching the same re-exec.
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        _reexec_clean_cpu(argv)
+    else:
+        probe_timeout = int(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "180"))
+        retries = int(os.environ.get("BENCH_BACKEND_PROBE_RETRIES", "3"))
+        alive = False
+        for attempt in range(retries):
+            if _default_backend_alive(probe_timeout):
+                alive = True
+                break
+            print(
+                f"# backend probe attempt {attempt + 1}/{retries} failed "
+                f"({probe_timeout}s)",
+                file=sys.stderr,
+            )
+            if attempt + 1 < retries:
+                time.sleep(15 * (attempt + 1))
+        if not alive:
+            print(
+                f"# default backend unreachable after {retries} probes; "
+                "falling back to CPU",
+                file=sys.stderr,
+            )
+            _reexec_clean_cpu(argv)
+            jax.config.update("jax_platforms", "cpu")
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     # CPU (whether fallback or a CPU-only host) can't absorb the TPU-sized
     # windowed fleets — bf16 is emulated there — so shrink the
@@ -514,6 +558,23 @@ def _setup_backend(argv) -> None:
         if "BENCH_WINDOWED_DTYPE" not in os.environ:
             WINDOWED_DTYPE = "float32"
         os.environ.setdefault("BENCH_AB_ROUNDS", "5")
+
+
+def _reexec_clean_cpu(argv) -> None:
+    """Replace this process with a clean-env CPU interpreter (once).
+
+    A wedged accelerator plugin blocks even the CPU platform in-process
+    (plugin init runs at first device op), so a CPU run must start without
+    the plugin's site hook on PYTHONPATH (bench.py re-inserts its own dir
+    on sys.path at startup). No-op when already re-exec'd.
+    """
+    if os.environ.get("GORDO_TPU_BENCH_REEXEC") == "1":
+        return
+    env = dict(os.environ)
+    env["GORDO_TPU_BENCH_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    os.execve(sys.executable, [sys.executable, __file__, *argv[1:]], env)
 
 
 def _section_child(name: str) -> None:
@@ -556,13 +617,14 @@ def _default_backend_alive(timeout_sec: int) -> bool:
 
 
 def main():
-    _setup_backend(sys.argv)
-
-    # EVERY section — including the headline — runs as a subprocess with a
-    # hard wall-clock timeout: the TPU tunnel here can wedge mid-run (a
-    # device call that HANGS, not raises — see _default_backend_alive), and
-    # a hang anywhere must not cost the whole record. A failed section
-    # degrades to an error entry; the one-line contract always holds.
+    # The parent NEVER touches jax: it only orchestrates section
+    # subprocesses, so a wedged accelerator plugin can't stall it. EVERY
+    # section — including the headline — runs as a subprocess with a hard
+    # wall-clock timeout: the TPU tunnel here can wedge mid-run (a device
+    # call that HANGS, not raises — see _default_backend_alive), and a hang
+    # anywhere must not cost the whole record. Each child re-probes the
+    # backend itself, so a tunnel that recovers mid-run gets used. A failed
+    # section degrades to an error entry; the one-line contract always holds.
     headline = _run_section("headline")
     head = headline.get("result") or {}
     windowed = {}
@@ -575,6 +637,28 @@ def main():
     serving = head.get("serving", {})
     torch_mpm = head.get("torch_baseline_machines_per_min") or 0
     mpm = head.get("machines_per_min") or 0
+
+    # Full detail: written to a file AND printed as an EARLIER stdout line.
+    # The FINAL line stays compact (<1KB): round 3's single giant line
+    # outgrew the driver's tail capture and truncated the headline value out
+    # of the permanent record (BENCH_r03.json "parsed": null).
+    detail = {
+        **head,
+        "windowed": windowed,
+        "batch_ab": batch_ab,
+        "platform": headline.get("platform", "unknown"),
+        "warmed": os.environ.get("BENCH_WARM", "1") != "0",
+    }
+    detail_file = os.environ.get("BENCH_DETAIL_FILE", "bench_detail.json")
+    try:
+        with open(detail_file, "w") as fh:
+            json.dump(detail, fh, indent=1)
+    except OSError:
+        detail_file = None
+    print(json.dumps({"detail": detail}))
+
+    win = windowed.get("result") or {}
+    ab = batch_ab.get("result") or {}
     out = {
         "metric": "autoencoder machines/min trained (4-tag hourglass AE, "
         "3-fold CV + thresholds, 1008 rows); server anomaly POST "
@@ -582,18 +666,38 @@ def main():
         "value": round(mpm, 2) if mpm else None,
         "unit": "machines/min",
         "vs_baseline": round(mpm / torch_mpm, 2) if torch_mpm else None,
+        "platform": headline.get("platform", "unknown"),
+        "mfu": head.get("mfu"),
         "server_samples_per_sec": serving.get("samples_per_sec"),
         "server_p50_anomaly_ms": serving.get("p50_ms"),
-        "detail": {
-            **head,
-            "windowed": windowed,
-            "batch_ab": batch_ab,
-            "platform": headline.get("platform", "unknown"),
-            "warmed": os.environ.get("BENCH_WARM", "1") != "0",
+        "windowed": {
+            "platform": windowed.get("platform"),
+            "vs_torch": {
+                k: v.get("vs_torch") for k, v in win.items() if isinstance(v, dict)
+            },
+            "mfu": {
+                k: v.get("mfu") for k, v in win.items() if isinstance(v, dict)
+            },
         },
+        "batch_ab": {
+            "platform": batch_ab.get("platform"),
+            "speedup": {
+                k: v.get("batching_speedup")
+                for k, v in ab.items()
+                if isinstance(v, dict)
+            },
+            "auto_vs_direct": {
+                k: v.get("auto_vs_direct")
+                for k, v in ab.items()
+                if isinstance(v, dict)
+            },
+        },
+        "detail_file": detail_file,
     }
-    if "error" in headline:
-        out["error"] = headline["error"]
+    for name, section in (("headline", headline), ("windowed", windowed),
+                          ("batch_ab", batch_ab)):
+        if "error" in section:
+            out.setdefault("errors", {})[name] = str(section["error"])[:160]
     print(json.dumps(out))
 
 
@@ -646,6 +750,18 @@ def _bench_headline() -> dict:
     # ---- serving: reference harness shape on the anomaly endpoint
     serving = _bench_serving(results[0])
 
+    # ---- MFU: analytic FLOPs per machine build (spec walk) over the
+    # batched wall against the chip's bf16 peak (ops/flops.py)
+    from gordo_tpu.models.models import AutoEncoder
+    from gordo_tpu.ops import flops as flops_mod
+
+    spec = AutoEncoder(kind="feedforward_hourglass").build_spec(4, 4)
+    machine_flops = flops_mod.cv_build_flops(spec, n_rows=1008, epochs=EPOCHS)
+    device_kind = jax.devices()[0].device_kind
+    mfu_val = flops_mod.mfu(
+        machine_flops * N_MACHINES, batched_sec, device_kind, len(jax.devices())
+    )
+
     return {
         "n_machines": N_MACHINES,
         "machines_per_min": round(machines_per_min, 2),
@@ -655,6 +771,9 @@ def _bench_headline() -> dict:
         "vs_own_serial": round(machines_per_min / serial_machines_per_min, 2),
         "serving": serving,
         "n_devices": len(jax.devices()),
+        "device_kind": device_kind,
+        "flops_per_machine": machine_flops,
+        "mfu": round(mfu_val, 5) if mfu_val is not None else None,
     }
 
 

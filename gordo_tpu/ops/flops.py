@@ -1,0 +1,181 @@
+"""
+Analytic FLOPs accounting per :class:`~gordo_tpu.models.spec.ModelSpec`.
+
+The reference publishes no performance numbers at all (BASELINE.md); for a
+TPU-native framework the honest single-chip yardstick is MFU — achieved
+FLOP/s divided by the chip's peak for the compute dtype. This module derives
+the FLOP count of a forward pass (and standard 3x training step) by walking
+the spec's layers, so ``bench.py`` can report MFU without instrumenting the
+compiled program.
+
+Conventions (standard accounting, matmul-dominated):
+- a matmul of (m, k) x (k, n) costs 2*m*k*n FLOPs
+- backward pass costs ~2x forward (grad wrt inputs + grad wrt weights)
+- elementwise work (activations, norms, residuals) is ignored — it is
+  bandwidth-, not FLOP-, bound and contributes <1% on these shapes
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gordo_tpu.models.spec import (
+    DenseLayer,
+    LSTMLayer,
+    ModelSpec,
+    MoEBlock,
+    PoolLayer,
+    PositionalEncoding,
+    TCNBlock,
+    TransformerBlock,
+)
+from gordo_tpu.ops.nn import layer_out_dim
+
+
+def forward_flops_per_sample(spec: ModelSpec) -> float:
+    """FLOPs of one forward pass for one sample.
+
+    For windowed models a "sample" is one lookback window of T =
+    ``spec.lookback_window`` timesteps; for dense models it is one row.
+    """
+    T = max(int(spec.lookback_window), 1)
+    windowed = T > 1
+    in_dim = spec.n_features
+    total = 0.0
+    seq = windowed  # whether the current tensor still has a time axis
+    for layer in spec.layers:
+        steps = T if seq else 1
+        if isinstance(layer, DenseLayer):
+            total += 2.0 * in_dim * layer.units * steps
+        elif isinstance(layer, LSTMLayer):
+            # 4 gates, each an (in + hidden) x hidden matmul per timestep
+            total += 8.0 * (in_dim * layer.units + layer.units**2) * T
+            seq = layer.return_sequences
+        elif isinstance(layer, TransformerBlock):
+            d, ff = layer.d_model, layer.ff_dim
+            # QKVO projections: 4 d x d matmuls per token
+            total += 8.0 * d * d * T
+            # scores (T x d x T) + weighted values (T x T x d), per sequence
+            total += 4.0 * T * T * d
+            # FFN: d->ff->d per token
+            total += 4.0 * d * ff * T
+        elif isinstance(layer, MoEBlock):
+            d = layer.d_model
+            total += 8.0 * d * d * T + 4.0 * T * T * d
+            # router + top-1 expert FFN per token
+            total += 2.0 * d * layer.num_experts * T
+            total += 4.0 * d * layer.expert_dim * T
+        elif isinstance(layer, TCNBlock):
+            # two causal dilated convs (+ a possible 1x1 residual projection)
+            k, f = layer.kernel_size, layer.filters
+            total += 2.0 * k * in_dim * f * T + 2.0 * k * f * f * T
+            if in_dim != f:
+                total += 2.0 * in_dim * f * T
+        elif isinstance(layer, (PoolLayer, PositionalEncoding)):
+            if isinstance(layer, PoolLayer):
+                seq = False
+        in_dim = layer_out_dim(layer, in_dim)
+    return total
+
+
+def training_flops_per_sample(spec: ModelSpec) -> float:
+    """Forward + backward (~2x forward); remat re-runs forward once more."""
+    mult = 4.0 if spec.remat else 3.0
+    return mult * forward_flops_per_sample(spec)
+
+
+def n_windows(spec: ModelSpec, n_rows: int) -> int:
+    """Output rows for an input of ``n_rows`` (window semantics parity with
+    reference models.py:715-796 via ModelSpec.output_offset)."""
+    return max(n_rows - spec.output_offset, 0)
+
+
+def cv_build_flops(
+    spec: ModelSpec,
+    n_rows: int,
+    epochs: int,
+    n_splits: int = 3,
+) -> float:
+    """Total FLOPs of one machine build: ``n_splits`` TimeSeriesSplit fold
+    trainings + fold predictions + the final full fit (the reference builder
+    contract, gordo/builder/build_model.py:169-289).
+
+    sklearn's TimeSeriesSplit on N rows yields train sizes k*N/(n_splits+1)
+    and test size N/(n_splits+1) per fold.
+    """
+    fwd = forward_flops_per_sample(spec)
+    train = training_flops_per_sample(spec)
+    fold = n_rows // (n_splits + 1)
+    total = 0.0
+    for k in range(1, n_splits + 1):
+        total += train * n_windows(spec, k * fold) * epochs
+        total += fwd * n_windows(spec, fold)
+    total += train * n_windows(spec, n_rows) * epochs
+    return total
+
+
+# bf16 peak matmul FLOP/s per chip, by jax device_kind substring. Public
+# figures (cloud.google.com/tpu docs); fp32 compute on TPU routes through the
+# same MXU via bf16x3 passes at roughly 1/2 throughput — MFU here is always
+# reported against the bf16 peak, the honest (hardest) denominator.
+_PEAK_BF16 = {
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+    "v5p": 459e12,
+    "v5e": 394e12,
+    "v5 lite": 394e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def chip_peak_flops(device_kind: str) -> Optional[float]:
+    """Peak bf16 FLOP/s for a ``jax.devices()[0].device_kind`` string, or
+    None when unknown (override with env ``GORDO_TPU_PEAK_FLOPS``)."""
+    import os
+
+    env = os.environ.get("GORDO_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = (device_kind or "").lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def mfu(
+    total_flops: float, wall_sec: float, device_kind: str, n_devices: int = 1
+) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1] against the HOST's aggregate peak
+    (chip peak x device count — a fleet build spreads machines over every
+    chip), or None when the chip peak is unknown (e.g. CPU fallback)."""
+    peak = chip_peak_flops(device_kind)
+    if not peak or wall_sec <= 0:
+        return None
+    return total_flops / wall_sec / (peak * max(n_devices, 1))
+
+
+def spec_param_count(spec: ModelSpec) -> int:
+    """Parameter count by the same layer walk (used for sanity checks)."""
+    in_dim = spec.n_features
+    total = 0
+    for layer in spec.layers:
+        if isinstance(layer, DenseLayer):
+            total += in_dim * layer.units + layer.units
+        elif isinstance(layer, LSTMLayer):
+            total += 4 * (in_dim * layer.units + layer.units**2 + layer.units)
+        elif isinstance(layer, TransformerBlock):
+            d = layer.d_model
+            total += 4 * d * d + 2 * d * layer.ff_dim
+        elif isinstance(layer, MoEBlock):
+            d = layer.d_model
+            total += 4 * d * d
+            total += d * layer.num_experts
+            total += layer.num_experts * 2 * d * layer.expert_dim
+        elif isinstance(layer, TCNBlock):
+            k, f = layer.kernel_size, layer.filters
+            total += k * in_dim * f + k * f * f
+        in_dim = layer_out_dim(layer, in_dim)
+    return total
