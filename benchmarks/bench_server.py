@@ -205,8 +205,10 @@ def run_concurrent(
         for i in range(n_models)
     ]
 
-    def drive(mode_on: bool) -> dict:
-        os.environ["GORDO_TPU_SERVING_BATCH"] = "1" if mode_on else "0"
+    def drive(mode: str) -> dict:
+        os.environ["GORDO_TPU_SERVING_BATCH"] = {
+            "direct": "0", "batched": "1", "auto": "auto",
+        }[mode]
         batcher_mod._batcher = None
         # warmup every model (jit + lru model cache), then a concurrent burst
         # so the batched mode's stacked program is compiled before timing —
@@ -258,8 +260,8 @@ def run_concurrent(
         wall = timeit.default_timer() - wall0
         times.sort()
         stats = batcher_mod._batcher.stats if batcher_mod._batcher else {}
-        return {
-            "mode": "batched" if mode_on else "direct",
+        out = {
+            "mode": mode,
             "arch": arch,
             "users": users,
             "n_models": n_models,
@@ -269,17 +271,31 @@ def run_concurrent(
             "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 3),
             "batcher_stats": dict(stats),
         }
+        if mode == "auto" and batcher_mod._batcher is not None:
+            # what the measured self-A/B decided for each spec
+            out["decisions"] = [
+                "batch" if on else "direct"
+                for on in batcher_mod._batcher._spec_on.values()
+            ]
+        return out
 
-    direct = drive(False)
-    batched = drive(True)
+    direct = drive("direct")
+    batched = drive("batched")
+    # production mode: the batcher measures itself at startup and stands
+    # down where it loses — recorded so the decision is part of the A/B
+    auto = drive("auto")
     speedup = batched["samples_per_sec"] / max(direct["samples_per_sec"], 1e-9)
     result = {
         "direct": direct,
         "batched": batched,
+        "auto": auto,
         "batching_speedup": round(speedup, 2),
+        "auto_vs_direct": round(
+            auto["samples_per_sec"] / max(direct["samples_per_sec"], 1e-9), 2
+        ),
     }
     if not quiet:
-        for row in (direct, batched):
+        for row in (direct, batched, auto):
             print(json.dumps(row))
         print(json.dumps({"batching_speedup": result["batching_speedup"]}))
     return result

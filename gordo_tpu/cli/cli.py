@@ -319,16 +319,22 @@ def batch_build(
 @click.option(
     "--batch-predicts/--no-batch-predicts",
     default=True,
-    envvar="GORDO_TPU_SERVING_BATCH",
-    help="Fuse concurrent same-architecture predicts into one device call",
+    # NOT GORDO_TPU_SERVING_BATCH: that env var carries the non-boolean
+    # mode string ("auto") this command exports below — click's BOOL
+    # coercion would crash on its own output on re-invocation
+    envvar="GORDO_SERVER_BATCH_PREDICTS",
+    help="Fuse concurrent same-architecture predicts into one device call "
+    "(self-measuring: a startup A/B per architecture stands batching down "
+    "where the fused call loses to per-request dispatch)",
 )
 def run_server_cli(host, port, workers, worker_connections, batch_predicts):
     """Run the gordo-tpu model server."""
     from gordo_tpu.server import run_server
 
     # the switch must be in env before workers fork; each worker process
-    # then builds its own batcher on first use
-    os.environ["GORDO_TPU_SERVING_BATCH"] = "1" if batch_predicts else "0"
+    # then builds its own batcher on first use. "auto" = measured per-spec
+    # self-A/B at first use (server/batcher.py), never a blind always-on
+    os.environ["GORDO_TPU_SERVING_BATCH"] = "auto" if batch_predicts else "0"
     run_server(host, port, workers, worker_connections=worker_connections)
 
 

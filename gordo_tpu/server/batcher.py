@@ -16,9 +16,22 @@ Shape discipline: inputs are pre-padded with the same power-of-two buckets
 as the per-request path (ops/train.py pad_for_predict) and the batch axis
 is padded to powers of two, so the compiled-program set stays bounded.
 
-Enabled in server processes via $GORDO_TPU_SERVING_BATCH=1 (run-server sets
-it with --batch-predicts); BaseJaxEstimator.predict routes through
-``maybe_submit`` which no-ops to the direct path when disabled.
+Batching only pays when the fused device call beats the per-request
+dispatches it replaces — true on an accelerator with real per-call latency,
+false for a host-bound microburst. So the batcher can MEASURE itself:
+``$GORDO_TPU_SERVING_BATCH=auto`` (what ``run-server --batch-predicts``
+sets) runs a one-time concurrent A/B per spec at first use — direct
+predicts vs batched submits under synthetic thread load — and stands down
+for that spec when batching loses, logging the measured numbers. ``=1``
+forces batching on (the benchmark harness uses this to record the A/B).
+``BaseJaxEstimator.predict`` routes through ``maybe_submit`` which no-ops
+to the direct path when disabled or stood down.
+
+Scheduling is work-conserving: the dispatcher drains whatever requests have
+accumulated while the previous device call ran and fuses exactly those —
+no timed window, no artificial latency floor (a fixed window was measured
+adding ~2-800ms p50 at low concurrency). ``GORDO_TPU_BATCH_WINDOW_MS``
+re-enables a timed collection window if ever wanted.
 """
 
 import functools
@@ -152,9 +165,10 @@ class CrossModelBatcher:
 
     def __init__(
         self,
-        window_ms: float = 2.0,
+        window_ms: float = 0.0,
         max_batch: int = 64,
         timeout_s: Optional[float] = None,
+        self_ab: bool = False,
     ):
         self.window_s = window_ms / 1e3
         self.max_batch = max_batch
@@ -170,13 +184,103 @@ class CrossModelBatcher:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._banks: Dict[Any, _ParamBank] = {}
+        # auto mode: per-spec measured go/no-go, filled by _calibrate
+        self.self_ab = self_ab
+        self._spec_on: Dict[Any, bool] = {}
+        self._calibrating: set = set()
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
 
     # ------------------------------------------------------------- public
-    def submit(self, spec, params, X) -> np.ndarray:
-        """Blocking predict through the batch queue (thread-safe)."""
+    def submit(self, spec, params, X) -> Optional[np.ndarray]:
+        """Blocking predict through the batch queue (thread-safe).
+
+        In auto (self-A/B) mode, returns ``None`` when measurement decided
+        batching loses for this spec — the caller then predicts direct.
+        """
+        if self.self_ab:
+            decision = self._spec_on.get(spec)
+            if decision is None:
+                decision = self._calibrate(spec, params, X)
+            if not decision:
+                return None
+        return self._force_submit(spec, params, X)
+
+    # -------------------------------------------------------- calibration
+    def _calibrate(self, spec, params, X) -> bool:
+        """One-time measured A/B for this spec: concurrent direct predicts
+        vs concurrent batched submits on the live input shape. The batched
+        arm doubles as program prewarm (stacked apply for the buckets real
+        load will hit), and compiles run before timing so the decision
+        reflects steady state. Returns (and records) whether batching won;
+        the measured numbers are logged either way.
+        """
+        from gordo_tpu.ops.train import predict_fn
+
+        with self._lock:
+            if spec in self._spec_on:
+                return self._spec_on[spec]
+            if spec in self._calibrating:
+                # another thread is measuring this spec right now; don't
+                # queue behind it — predict direct this once
+                return False
+            self._calibrating.add(spec)
+        try:
+            users = int(os.environ.get("GORDO_TPU_BATCH_AB_USERS", "8"))
+            rounds = int(os.environ.get("GORDO_TPU_BATCH_AB_ROUNDS", "4"))
+            direct = predict_fn(spec)
+
+            def drive(fn) -> float:
+                errors: List[BaseException] = []
+
+                def worker():
+                    try:
+                        for _ in range(rounds):
+                            fn()
+                    except BaseException as exc:  # noqa: BLE001
+                        errors.append(exc)
+
+                threads = [
+                    threading.Thread(target=worker) for _ in range(users)
+                ]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                return time.monotonic() - t0
+
+            # warm both arms (XLA compiles, param-bank stack) before timing
+            direct(params, np.asarray(X))
+            self._force_submit(spec, params, X)
+            drive(lambda: self._force_submit(spec, params, X))
+
+            wall_direct = drive(lambda: direct(params, np.asarray(X)))
+            wall_batched = drive(lambda: self._force_submit(spec, params, X))
+            won = wall_batched < wall_direct
+            logger.info(
+                "serving batcher self-A/B for %s models (%d users x %d "
+                "rounds): direct %.1fms, batched %.1fms -> batching %s",
+                type(spec.layers[0]).__name__ if spec.layers else "?",
+                users, rounds, wall_direct * 1e3, wall_batched * 1e3,
+                "ON" if won else "OFF (stood down: fused call loses to "
+                "per-request dispatch on this backend)",
+            )
+        except Exception as exc:  # noqa: BLE001 — measurement must not 500;
+            # KeyboardInterrupt/SystemExit propagate (an operator's Ctrl-C
+            # must not be converted into a silent stand-down)
+            logger.warning("batcher self-A/B failed (%s); standing down", exc)
+            won = False
+        with self._lock:
+            self._spec_on[spec] = won
+            self._calibrating.discard(spec)
+        return won
+
+    def _force_submit(self, spec, params, X) -> np.ndarray:
+        """submit() minus the auto-mode gate (used by calibration)."""
         from gordo_tpu.ops.train import pad_for_predict
 
         X_pad, n_pad, n_keep = pad_for_predict(spec, X)
@@ -186,7 +290,7 @@ class CrossModelBatcher:
         if not item.done.wait(timeout=self.timeout_s):
             raise TimeoutError(
                 f"batched predict timed out after {self.timeout_s:.0f}s"
-            )  # wait() only returns False with a finite timeout
+            )
         if item.error is not None:
             raise item.error
         return item.result
@@ -205,15 +309,25 @@ class CrossModelBatcher:
     def _loop(self):
         while True:
             batch = [self._q.get()]
-            deadline = time.monotonic() + self.window_s
-            while len(batch) < self.max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._q.get(timeout=remaining))
-                except queue.Empty:
-                    break
+            if self.window_s > 0:
+                # optional timed collection window (off by default)
+                deadline = time.monotonic() + self.window_s
+                while len(batch) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._q.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+            else:
+                # work-conserving: fuse exactly the requests that piled up
+                # while the previous device call ran; never wait for more
+                while len(batch) < self.max_batch:
+                    try:
+                        batch.append(self._q.get_nowait())
+                    except queue.Empty:
+                        break
             self._run(batch)
 
     def _run(self, batch: List[_Item]):
@@ -271,22 +385,28 @@ _batcher_lock = threading.Lock()
 
 
 def get_batcher() -> Optional[CrossModelBatcher]:
-    """The process batcher, created on first use when enabled by env."""
+    """The process batcher, created on first use when enabled by env.
+
+    ``GORDO_TPU_SERVING_BATCH``: ``auto`` = on with per-spec measured
+    self-A/B (stands down where batching loses); ``1``/``true``/``yes`` =
+    forced on (benchmark harness); anything else = off.
+    """
     global _batcher
     if _batcher is not None:
         return _batcher
-    if os.environ.get("GORDO_TPU_SERVING_BATCH", "").lower() not in (
-        "1", "true", "yes",
-    ):
+    mode = os.environ.get("GORDO_TPU_SERVING_BATCH", "").lower()
+    if mode not in ("1", "true", "yes", "auto"):
         return None
     with _batcher_lock:
         if _batcher is None:
-            window_ms = float(os.environ.get("GORDO_TPU_BATCH_WINDOW_MS", "2"))
+            window_ms = float(os.environ.get("GORDO_TPU_BATCH_WINDOW_MS", "0"))
             max_batch = int(os.environ.get("GORDO_TPU_BATCH_MAX", "64"))
-            _batcher = CrossModelBatcher(window_ms, max_batch)
+            _batcher = CrossModelBatcher(
+                window_ms, max_batch, self_ab=mode == "auto"
+            )
             logger.info(
-                "cross-model batcher on (window %.1fms, max %d)",
-                window_ms, max_batch,
+                "cross-model batcher on (window %.1fms, max %d, self-A/B %s)",
+                window_ms, max_batch, "on" if mode == "auto" else "off",
             )
     return _batcher
 

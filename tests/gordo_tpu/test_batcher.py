@@ -142,3 +142,55 @@ def test_server_end_to_end_with_batching(
         # 'time-seconds' is wall time; the payload proper must be identical
         assert json.loads(resp.data)["data"] == json.loads(baseline.data)["data"]
     monkeypatch.setattr(batcher_mod, "_batcher", None)
+
+
+# ------------------------------------------------------------ auto (self-A/B)
+def test_auto_mode_calibrates_once_and_honours_decision(models, monkeypatch):
+    """auto mode: one measured A/B per spec; a losing spec predicts direct
+    (submit returns None), a winning spec keeps batching."""
+    monkeypatch.setenv("GORDO_TPU_BATCH_AB_USERS", "2")
+    monkeypatch.setenv("GORDO_TPU_BATCH_AB_ROUNDS", "1")
+    b = CrossModelBatcher(max_batch=8, self_ab=True)
+    m = models[0]
+    X = np.random.RandomState(3).rand(30, 4).astype(np.float32)
+
+    out = b.submit(m.spec_, m.params_, X)
+    assert m.spec_ in b._spec_on  # calibration ran and recorded a decision
+    decision = b._spec_on[m.spec_]
+    if decision:
+        assert out is not None
+        np.testing.assert_allclose(out, m.predict(X), rtol=1e-5, atol=1e-6)
+    else:
+        assert out is None  # stood down: caller goes direct
+
+    # second submit must not re-calibrate (decision is sticky)
+    calls = []
+    monkeypatch.setattr(
+        b, "_calibrate", lambda *a, **k: calls.append(1) or True
+    )
+    b.submit(m.spec_, m.params_, X)
+    assert not calls
+
+
+def test_auto_mode_forced_decision_routes(models):
+    """With the decision pinned, submit() either batches or hands back."""
+    m = models[0]
+    X = np.random.RandomState(4).rand(16, 4).astype(np.float32)
+    b = CrossModelBatcher(max_batch=8, self_ab=True)
+    b._spec_on[m.spec_] = False
+    assert b.submit(m.spec_, m.params_, X) is None
+    b._spec_on[m.spec_] = True
+    out = b.submit(m.spec_, m.params_, X)
+    np.testing.assert_allclose(out, m.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_env_auto_enables_self_ab(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "auto")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    b = batcher_mod.get_batcher()
+    assert b is not None and b.self_ab
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    b = batcher_mod.get_batcher()
+    assert b is not None and not b.self_ab
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
