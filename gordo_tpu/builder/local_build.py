@@ -6,7 +6,7 @@ entry the test-suite uses to produce real artifacts quickly, and the
 fallback serial path of the batched trainer.
 """
 
-from typing import Iterable, Optional, Tuple, Union
+from typing import Iterable, Tuple, Union
 
 import yaml
 

@@ -10,7 +10,7 @@ ordered so subclasses win over base classes.
 import enum
 import json
 import traceback
-from typing import IO, List, Optional, Tuple, Type, Union
+from typing import IO, List, Optional, Tuple, Type
 
 from gordo_tpu.util.text import replace_all_non_ascii_chars
 

@@ -12,7 +12,6 @@ process on a TPU-VM device mesh by ``gordo-tpu batch-build``.
 
 import json
 import logging
-import sys
 from typing import Any, Dict, List, Optional
 
 import click

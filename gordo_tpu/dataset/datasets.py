@@ -17,13 +17,13 @@ import abc
 import logging
 import time
 from datetime import datetime
-from typing import Dict, Iterable, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
 
 from .data_provider import GordoBaseDataProvider, RandomDataProvider
-from .sensor_tag import SensorTag, normalize_sensor_tags
+from .sensor_tag import normalize_sensor_tags
 
 logger = logging.getLogger(__name__)
 

@@ -7,7 +7,7 @@ durations), serialized with dataclasses_json just like the reference.
 """
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from dataclasses_json import dataclass_json
 

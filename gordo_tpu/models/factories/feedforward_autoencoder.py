@@ -9,7 +9,7 @@ architectures share one compiled XLA program, and parameters initialize as
 vmap-able pytrees.
 """
 
-from typing import Any, Dict, Optional, Tuple, Union
+from typing import Any, Dict, Optional, Tuple
 
 from gordo_tpu.models.register import register_model_builder
 from gordo_tpu.models.spec import DenseLayer, ModelSpec, OptimizerSpec

@@ -122,7 +122,6 @@ class MlFlowReporter(BaseReporter):
 
     def report(self, machine) -> None:
         try:
-            import mlflow
             from mlflow.entities import Metric, Param
             from mlflow.tracking import MlflowClient
         except ImportError as exc:

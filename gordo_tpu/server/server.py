@@ -18,7 +18,7 @@ import logging
 import os
 import re
 import timeit
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import simplejson
 from werkzeug.exceptions import HTTPException

@@ -16,7 +16,7 @@ import pickle
 import zlib
 from datetime import datetime
 from functools import lru_cache
-from typing import List, Optional, Union
+from typing import List
 
 import dateutil.parser
 import pandas as pd

@@ -8,20 +8,17 @@ prediction, anomaly prediction (smoothed columns dropped unless
 Implemented as plain functions over a per-request context (no flask.g).
 """
 
-import io
 import logging
 import os
 import timeit
 import traceback
-from typing import Optional
 
-import numpy as np
 import pandas as pd
 from werkzeug.exceptions import NotFound
 from werkzeug.wrappers import Response
 
 from gordo_tpu import __version__, serializer
-from gordo_tpu.dataset.sensor_tag import SensorTag, normalize_sensor_tags
+from gordo_tpu.dataset.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
 from gordo_tpu.server import model_io
 from gordo_tpu.server import utils as server_utils

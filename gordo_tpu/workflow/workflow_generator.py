@@ -12,7 +12,7 @@ instead of one pod per machine.
 import logging
 import os
 from datetime import datetime, timezone
-from typing import Any, Dict, Iterable, List, Optional, Union
+from typing import Any, Iterable, List, Optional, Union
 
 import jinja2
 import yaml
