@@ -214,12 +214,15 @@ def _apply_lstm(layer: LSTMLayer, p, x):
     rec_act = _activation(layer.recurrent_activation)
     batch = x.shape[0]
 
+    W = jnp.concatenate([p["kernel"], p["recurrent_kernel"]], axis=0)
+
     def step(carry, xt):
         h, c = carry
-        # gate matmuls run at the input (compute) dtype; the recurrent cell
-        # state accumulates in float32 — bf16's 8-bit mantissa drifts badly
-        # over long scans in `c = f*c + i*g`
-        z = (xt @ p["kernel"] + h.astype(xt.dtype) @ p["recurrent_kernel"]
+        # one fused (B, in+units) @ (in+units, 4*units) gate matmul; runs at
+        # the input (compute) dtype; the recurrent cell state accumulates in
+        # float32 — bf16's 8-bit mantissa drifts badly over long scans in
+        # `c = f*c + i*g`
+        z = (jnp.concatenate([xt, h.astype(xt.dtype)], axis=1) @ W
              + p["bias"]).astype(jnp.float32)
         i = rec_act(z[:, :units])
         f = rec_act(z[:, units : 2 * units])
@@ -227,7 +230,11 @@ def _apply_lstm(layer: LSTMLayer, p, x):
         o = rec_act(z[:, 3 * units :])
         c = f * c + i * g
         h = o * act(c)
-        return (h, c), h.astype(xt.dtype)
+        # per-step outputs are only materialized when a sequence is
+        # consumed downstream; a many-to-one tail layer skips the (T, B, U)
+        # stacked buffer entirely
+        out = h.astype(xt.dtype) if layer.return_sequences else None
+        return (h, c), out
 
     h0 = jnp.zeros((batch, units), jnp.float32)
     c0 = jnp.zeros((batch, units), jnp.float32)

@@ -177,7 +177,9 @@ def _build_eval_fn(spec: ModelSpec, n_samples: int, batch_size: int = 2048) -> C
             bw = jnp.sum(wb)
             return (loss_sum + loss * bw, w_sum + bw), None
 
-        (loss_sum, w_sum), _ = jax.lax.scan(body, (jnp.asarray(0.0), jnp.asarray(0.0)), jnp.arange(n_steps))
+        (loss_sum, w_sum), _ = jax.lax.scan(
+            body, (jnp.asarray(0.0), jnp.asarray(0.0)), jnp.arange(n_steps)
+        )
         return loss_sum / jnp.maximum(w_sum, 1.0)
 
     return jax.jit(evaluate)
@@ -202,9 +204,17 @@ def make_masked_epoch_fn(
     This is what lets the batched trainer run every CV fold — each a
     different train-prefix length — through ONE compiled body inside a
     ``lax.scan`` over folds, instead of unrolling a separately-shaped fit per
-    fold. Compile time of the fleet program drops by ~the fold count; the
-    price is dead trailing steps on short folds, which for the small
-    per-machine models is far below the compile saving.
+    fold. Compile time of the fleet program drops by ~the fold count.
+
+    The minibatch loop is a ``lax.while_loop`` with the live step count
+    ``ceil(n_valid / batch_size)`` as its (traced) bound, so short folds run
+    only their live steps instead of the full-fit step count — the static
+    schedule was measured executing ~1.6x the live work across a 3-fold CV
+    build, each dead step a full windowed forward+backward for LSTM/
+    Transformer fleets. Fold schedules are uniform across a bucket's
+    machines, so under the machine vmap every lane ends at the same bound;
+    a non-uniform caller still gets correct results (late lanes' steps are
+    zero-weight masked no-ops), just max-lane timing.
     """
     n_steps = max((n_max + batch_size - 1) // batch_size, 1)
     n_pad = n_steps * batch_size
@@ -232,9 +242,15 @@ def make_masked_epoch_fn(
                 jnp.zeros((n_pad - n_max,), jnp.float32),
             ]
         )
+        n_live_steps = jnp.clip(
+            (n_valid + batch_size - 1) // batch_size, 1, n_steps
+        )
 
-        def body(carry, i):
-            params, opt_state, loss_sum, w_sum = carry
+        def cond(state):
+            return state[0] < n_live_steps
+
+        def body(state):
+            i, params, opt_state, loss_sum, w_sum = state
             idx = jax.lax.dynamic_slice(idx_stream, (i * batch_size,), (batch_size,))
             wb = jax.lax.dynamic_slice(w_stream, (i * batch_size,), (batch_size,))
             xb, yb = _gather_batch(spec, X, y, idx)
@@ -251,11 +267,14 @@ def make_masked_epoch_fn(
             params = pick(new_params, params)
             opt_state = pick(new_opt_state, opt_state)
             loss = jnp.where(live, loss, 0.0)
-            return (params, opt_state, loss_sum + loss * bw, w_sum + bw), None
+            return (i + 1, params, opt_state, loss_sum + loss * bw, w_sum + bw)
 
-        init = (params, opt_state, jnp.asarray(0.0), jnp.asarray(0.0))
-        (params, opt_state, loss_sum, w_sum), _ = jax.lax.scan(
-            body, init, jnp.arange(n_steps)
+        init = (
+            jnp.asarray(0, n_live_steps.dtype), params, opt_state,
+            jnp.asarray(0.0), jnp.asarray(0.0),
+        )
+        _, params, opt_state, loss_sum, w_sum = jax.lax.while_loop(
+            cond, body, init
         )
         return params, opt_state, loss_sum / jnp.maximum(w_sum, 1.0)
 
