@@ -14,7 +14,6 @@ samples/sec.
 """
 
 import argparse
-import io
 import json
 import os
 import statistics

@@ -25,10 +25,6 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402 — platform chosen via env above
 
-import numpy as np
-import pandas as pd
-import yaml
-
 from gordo_tpu import serializer
 from gordo_tpu.parallel import BatchedModelBuilder
 from gordo_tpu.server.server import build_app

@@ -6,11 +6,8 @@ CPU platform with 8 virtual devices so mesh/sharding logic runs anywhere; the
 same code path runs unchanged on real TPU chips.
 """
 
-import os
 
-import numpy as np
 import pytest
-import yaml
 
 from gordo_tpu import serializer
 from gordo_tpu.builder.local_build import local_build

@@ -1,8 +1,6 @@
 import numpy as np
 import pytest
-import yaml
 
-from gordo_tpu import serializer
 from gordo_tpu.builder import ModelBuilder, local_build
 from gordo_tpu.machine import Machine
 

@@ -1,12 +1,11 @@
 import json
-import os
 
 import pytest
 import yaml
 from click.testing import CliRunner
 
 from gordo_tpu.cli.cli import build, expand_model, gordo
-from gordo_tpu.cli.custom_types import HostIP, key_value_par
+from gordo_tpu.cli.custom_types import key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
 
 

@@ -14,6 +14,7 @@ is not available on the host.
 """
 
 import shutil
+import socket
 import subprocess
 import time
 import uuid
@@ -26,18 +27,28 @@ pytestmark = pytest.mark.dockertest
 
 _HAS_DOCKER = shutil.which("docker") is not None
 
-INFLUX_PORT = 18086
-PG_PORT = 15432
+
+def _free_port() -> int:
+    """Ephemeral host port — concurrent dockertest runs on one host must
+    not collide (container names are uuid-unique already)."""
+    with socket.socket() as sock:
+        sock.bind(("", 0))
+        return sock.getsockname()[1]
 
 
 def _docker_run(image: str, name: str, ports: dict, env: dict) -> str:
+    """Start a detached container, or SKIP the test: an installed docker
+    CLI with a stopped daemon, no network to pull the image, or an
+    allocated port are environment problems, not failures."""
     cmd = ["docker", "run", "--rm", "-d", "--name", name]
     for host, cont in ports.items():
         cmd += ["-p", f"{host}:{cont}"]
     for key, value in env.items():
         cmd += ["-e", f"{key}={value}"]
     cmd.append(image)
-    out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    if out.returncode != 0:
+        pytest.skip(f"docker run {image} failed: {out.stderr.strip()[:200]}")
     return out.stdout.strip()
 
 
@@ -62,17 +73,18 @@ def influxdb():
     if not _HAS_DOCKER:
         pytest.skip("docker CLI not available")
     name = f"gordo-tpu-influx-{uuid.uuid4().hex[:8]}"
+    port = _free_port()
     _docker_run(
         "influxdb:1.7-alpine",
         name,
-        ports={INFLUX_PORT: 8086},
+        ports={port: 8086},
         env={
             "INFLUXDB_DB": "gordo",
             "INFLUXDB_ADMIN_USER": "admin",
             "INFLUXDB_ADMIN_PASSWORD": "pass",
         },
     )
-    base = f"http://localhost:{INFLUX_PORT}"
+    base = f"http://localhost:{port}"
     try:
         if not _wait_for(
             lambda: requests.get(f"{base}/ping", timeout=2).status_code == 204
@@ -89,16 +101,17 @@ def postgresdb():
         pytest.skip("docker CLI not available")
     psycopg2 = pytest.importorskip("psycopg2")
     name = f"gordo-tpu-pg-{uuid.uuid4().hex[:8]}"
+    port = _free_port()
     _docker_run(
         "postgres:11-alpine",
         name,
-        ports={PG_PORT: 5432},
+        ports={port: 5432},
         env={"POSTGRES_USER": "postgres", "POSTGRES_PASSWORD": "postgres"},
     )
 
     def _ping():
         conn = psycopg2.connect(
-            host="localhost", port=PG_PORT, user="postgres",
+            host="localhost", port=port, user="postgres",
             password="postgres", dbname="postgres", connect_timeout=2,
         )
         conn.close()
@@ -107,7 +120,7 @@ def postgresdb():
     try:
         if not _wait_for(_ping):
             pytest.skip("postgres container failed to become ready")
-        yield {"host": "localhost", "port": PG_PORT}
+        yield {"host": "localhost", "port": port}
     finally:
         _docker_kill(name)
 

@@ -17,8 +17,6 @@ from gordo_tpu.models.models import TransformerAutoEncoder
 from gordo_tpu.models.spec import MoEBlock
 from gordo_tpu.ops.nn import (
     _apply_moe_block,
-    apply_model,
-    init_model_params,
     init_moe_block,
     moe_capacity,
     moe_dispatch_ffn,

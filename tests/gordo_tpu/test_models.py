@@ -63,7 +63,9 @@ def test_autoencoder_invalid_kind():
 
 def test_autoencoder_pickle_roundtrip(Xy):
     X, y = Xy
-    model = AutoEncoder(kind="feedforward_symmetric", dims=(8, 4), funcs=("tanh", "tanh"), epochs=1)
+    model = AutoEncoder(
+        kind="feedforward_symmetric", dims=(8, 4), funcs=("tanh", "tanh"), epochs=1
+    )
     model.fit(X, y)
     out = model.predict(X)
     model2 = pickle.loads(pickle.dumps(model))

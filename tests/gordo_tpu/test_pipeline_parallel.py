@@ -5,8 +5,6 @@ sequential block loop (same math, different placement), and pipelined
 specs keep off both vmapping paths like ring/TP.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,7 +18,6 @@ from gordo_tpu.ops.nn import (
     init_model_params,
 )
 from gordo_tpu.parallel.pipeline_parallel import (
-    apply_pipelined_blocks,
     make_pipeline_blocks_fn,
     pp_degree,
     prepare_pp_spec,

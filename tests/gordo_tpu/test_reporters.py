@@ -7,7 +7,6 @@ uses, tests/conftest.py:270-332); mlflow batching logic tested pure
 (reference tests/gordo/reporters/test_mlflow.py).
 """
 
-import json
 import sqlite3
 
 import pytest
@@ -108,7 +107,6 @@ def test_postgres_reporter_from_runtime_config(machine, sqlite_factory):
 
 def test_machine_report_dispatch(machine, sqlite_factory, monkeypatch):
     """Machine.report() runs every reporter in runtime.reporters."""
-    import gordo_tpu.reporters.postgres as pg
 
     seen = []
     monkeypatch.setattr(
