@@ -795,9 +795,11 @@ class BatchedModelBuilder:
             from jax.sharding import NamedSharding, PartitionSpec
 
             # fold permutations are identical for every machine (same seed,
-            # same row count): one replicated array, not a vmapped axis
-            perms_d = jax.device_put(
-                perms, NamedSharding(self.mesh, PartitionSpec())
+            # same row count): one replicated array, not a vmapped axis.
+            # make_global_stacked handles the multi-process world, where a
+            # plain device_put cannot address other hosts' devices
+            perms_d = distributed.make_global_stacked(
+                NamedSharding(self.mesh, PartitionSpec()), perms
             )
 
         t0 = time.time()

@@ -47,6 +47,37 @@ CONFIG = {
         }
         for i in range(N_MACHINES)
     ]
+    + [
+        # seeded-KFold KFCV machine: exercises the permuted bucket program
+        # (replicated perms array) on the multi-host mesh
+        {
+            "name": "dist-kfold",
+            "dataset": {
+                "type": "RandomDataset",
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+                "tags": ["dtag-kf-a", "dtag-kf-b"],
+            },
+            "model": {
+                "gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector": {
+                    "window": 12,
+                    "base_estimator": {
+                        "gordo_tpu.models.models.AutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "epochs": 1,
+                        }
+                    },
+                }
+            },
+            "evaluation": {
+                "cv": {
+                    "sklearn.model_selection.KFold": {
+                        "n_splits": 3, "shuffle": True, "random_state": 0,
+                    }
+                }
+            },
+        }
+    ]
 }
 
 WORKER = """
@@ -130,7 +161,7 @@ def test_processes_partition_the_fleet(dist_outdir):
     for pid in range(2):
         with open(os.path.join(dist_outdir, f"manifest-{pid}.json")) as f:
             manifests.append(json.load(f))
-    all_names = {f"dist-m{i}" for i in range(N_MACHINES)}
+    all_names = {f"dist-m{i}" for i in range(N_MACHINES)} | {"dist-kfold"}
     built = [name for m in manifests for name in m]
     assert sorted(built) == sorted(all_names), (manifests, all_names)
     # disjoint shards: no machine trained (or saved) twice
@@ -154,6 +185,18 @@ def test_artifacts_load_and_score(dist_outdir):
     frame = model.anomaly(X, X.copy(), frequency=pd.Timedelta("10min"))
     total = frame["total-anomaly-scaled"].to_numpy()
     assert np.isfinite(total).all()
+
+
+def test_kfold_kfcv_trained_on_multihost_mesh(dist_outdir):
+    """The seeded-KFold permuted program ran distributed and produced a
+    working thresholded detector."""
+    from gordo_tpu import serializer
+    from gordo_tpu.models.anomaly.diff import DiffBasedKFCVAnomalyDetector
+
+    model = serializer.load(os.path.join(dist_outdir, "dist-kfold"))
+    assert isinstance(model, DiffBasedKFCVAnomalyDetector)
+    assert np.isfinite(model.aggregate_threshold_)
+    assert np.isfinite(np.asarray(model.feature_thresholds_)).all()
 
 
 def test_distributed_matches_single_process(dist_outdir):
@@ -266,7 +309,8 @@ def test_multiprocess_cache_resume():
 
     first = _run_resume_workers(outdir, "first")
     built = [name for m in first for name, _ in m]
-    assert sorted(built) == sorted(f"dist-m{i}" for i in range(N_MACHINES))
+    expected = sorted([f"dist-m{i}" for i in range(N_MACHINES)] + ["dist-kfold"])
+    assert sorted(built) == expected
     assert not any(cached for m in first for _, cached in m)
 
     second = _run_resume_workers(outdir, "second")
