@@ -1,0 +1,152 @@
+"""Analytic FLOPs/MFU accounting (ops/flops.py) — the bench's MFU inputs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.models.models import (
+    AutoEncoder,
+    LSTMAutoEncoder,
+    TransformerAutoEncoder,
+)
+from gordo_tpu.ops import flops as flops_mod
+from gordo_tpu.ops.nn import init_model_params, moe_aux_loss
+from gordo_tpu.models.spec import MoEBlock
+
+
+def _spec(est):
+    return est.build_spec(8, 8)
+
+
+@pytest.mark.parametrize(
+    "est",
+    [
+        AutoEncoder(kind="feedforward_hourglass"),
+        LSTMAutoEncoder(
+            kind="lstm_symmetric", dims=[64, 32], funcs=["tanh", "tanh"],
+            lookback_window=16,
+        ),
+        TransformerAutoEncoder(kind="transformer_model", lookback_window=16),
+        TransformerAutoEncoder(
+            kind="moe_transformer_model", lookback_window=16, num_experts=4
+        ),
+    ],
+    ids=["hourglass", "lstm", "transformer", "moe"],
+)
+def test_param_count_matches_initialized_tree(est):
+    """The layer-walk parameter count must match the real pytree — the same
+    walk prices the FLOPs, so a drift here means wrong MFU."""
+    spec = _spec(est)
+    params = init_model_params(jax.random.PRNGKey(0), spec)
+    # the walk counts matmul/recurrent weights + their biases; layernorm
+    # scales/biases and attention biases are excluded (negligible FLOPs).
+    counted = flops_mod.spec_param_count(spec)
+    actual = sum(int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(params))
+    assert counted <= actual
+    assert counted >= 0.7 * actual, (counted, actual)
+
+
+def test_forward_flops_scale_with_window_and_width():
+    lstm16 = _spec(LSTMAutoEncoder(
+        kind="lstm_symmetric", dims=[64, 32], funcs=["tanh", "tanh"],
+        lookback_window=16,
+    ))
+    lstm64 = _spec(LSTMAutoEncoder(
+        kind="lstm_symmetric", dims=[64, 32], funcs=["tanh", "tanh"],
+        lookback_window=64,
+    ))
+    f16 = flops_mod.forward_flops_per_sample(lstm16)
+    f64 = flops_mod.forward_flops_per_sample(lstm64)
+    assert f16 > 0
+    # LSTM cost is linear in T
+    np.testing.assert_allclose(f64 / f16, 4.0, rtol=0.01)
+
+    # attention adds a quadratic-in-T term: more than 4x when T quadruples
+    tr16 = _spec(TransformerAutoEncoder(kind="transformer_model", lookback_window=16))
+    tr64 = _spec(TransformerAutoEncoder(kind="transformer_model", lookback_window=64))
+    assert (
+        flops_mod.forward_flops_per_sample(tr64)
+        > 4.0 * flops_mod.forward_flops_per_sample(tr16)
+    )
+
+
+def test_cv_build_flops_composition():
+    """3 folds + final fit, training 3x forward, remat 4x."""
+    spec = _spec(AutoEncoder(kind="feedforward_hourglass"))
+    fwd = flops_mod.forward_flops_per_sample(spec)
+    total = flops_mod.cv_build_flops(spec, n_rows=400, epochs=2, n_splits=3)
+    # train work: folds of 100/200/300 rows + full 400, 2 epochs, 3x fwd;
+    # predict work: 3 x 100-row fold predictions
+    expected = 3 * fwd * (100 + 200 + 300 + 400) * 2 + fwd * 300
+    np.testing.assert_allclose(total, expected, rtol=1e-9)
+
+    import dataclasses
+
+    remat = dataclasses.replace(spec, remat=True)
+    assert flops_mod.training_flops_per_sample(remat) == pytest.approx(
+        4 / 3 * flops_mod.training_flops_per_sample(spec)
+    )
+
+
+def test_mfu_and_peak_lookup():
+    assert flops_mod.chip_peak_flops("TPU v4") == 275e12
+    assert flops_mod.chip_peak_flops("TPU v5 lite") == 394e12
+    assert flops_mod.chip_peak_flops("cpu-whatever") is None
+    assert flops_mod.mfu(1e12, 1.0, "TPU v4") == pytest.approx(1e12 / 275e12)
+    # aggregate peak scales with device count
+    assert flops_mod.mfu(1e12, 1.0, "TPU v4", n_devices=4) == pytest.approx(
+        1e12 / (4 * 275e12)
+    )
+    assert flops_mod.mfu(1e12, 1.0, "unknown") is None
+
+
+def test_peak_env_override(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_PEAK_FLOPS", "1e15")
+    assert flops_mod.chip_peak_flops("anything") == 1e15
+
+
+# --------------------------------------------------------- MoE aux loss
+def test_moe_aux_loss_uniform_vs_collapsed():
+    """Switch load-balancing loss: 1.0 under uniform routing, -> E under
+    full collapse (every token to one expert)."""
+    layer = MoEBlock(d_model=8, num_experts=4)
+    n = 64
+    uniform = jnp.tile(jnp.full((1, 4), 0.25), (n, 1))
+    # perturb so argmax spreads evenly across experts
+    bump = jax.nn.one_hot(jnp.arange(n) % 4, 4) * 0.01
+    val_uniform = float(moe_aux_loss(layer, uniform + bump))
+    assert val_uniform == pytest.approx(1.0, rel=0.05)
+
+    collapsed = jnp.tile(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]), (n, 1))
+    val_collapsed = float(moe_aux_loss(layer, collapsed))
+    assert val_collapsed > 3.5  # ~ E * P_hot
+
+
+def test_moe_aux_loss_reaches_training_penalty():
+    """apply_model threads the weighted aux loss into the penalty the
+    training loss adds — the mechanism that prevents expert collapse."""
+    import dataclasses
+
+    from gordo_tpu.ops.nn import apply_model
+
+    est = TransformerAutoEncoder(
+        kind="moe_transformer_model", lookback_window=8, num_experts=4
+    )
+    spec = est.build_spec(4, 4)
+    params = init_model_params(jax.random.PRNGKey(0), spec)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (6, 8, 4))
+    _, penalty = apply_model(spec, params, x)
+    assert float(penalty) > 0.0
+
+    moe_idx = [
+        i for i, l in enumerate(spec.layers) if isinstance(l, MoEBlock)
+    ]
+    zeroed_layers = tuple(
+        dataclasses.replace(l, aux_loss_weight=0.0) if isinstance(l, MoEBlock) else l
+        for l in spec.layers
+    )
+    spec0 = dataclasses.replace(spec, layers=zeroed_layers)
+    _, penalty0 = apply_model(spec0, params, x)
+    assert float(penalty0) < float(penalty)
+    assert moe_idx  # the factory really emits MoE blocks
