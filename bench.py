@@ -460,6 +460,10 @@ def _run_section(name: str) -> dict:
         # CPU-fallback run still builds the full 1024-machine fleet plus two
         # torch baselines
         timeout = max(timeout, 3600)
+    if name == "batch_ab" and "BENCH_SECTION_TIMEOUT_BATCH_AB" not in os.environ:
+        # three drives (direct/batched/auto) x two archs, plus the probe
+        # retry budget when the tunnel is wedged
+        timeout = max(timeout, 3000)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--section", name],

@@ -227,45 +227,87 @@ class CrossModelBatcher:
                 return False
             self._calibrating.add(spec)
         try:
-            users = int(os.environ.get("GORDO_TPU_BATCH_AB_USERS", "8"))
-            rounds = int(os.environ.get("GORDO_TPU_BATCH_AB_ROUNDS", "4"))
+            # clamped: zero users/rounds would leave the sample list empty
+            # and turn a config mistake into a cryptic stand-down
+            users = max(1, int(os.environ.get("GORDO_TPU_BATCH_AB_USERS", "8")))
+            rounds = max(1, int(os.environ.get("GORDO_TPU_BATCH_AB_ROUNDS", "4")))
             direct = predict_fn(spec)
 
+            hostwork_s = float(
+                os.environ.get("GORDO_TPU_BATCH_AB_HOSTWORK_MS", "2")
+            ) / 1e3
+
+            def host_work():
+                """GIL-holding busy work between calls, standing in for the
+                serving path's parse/validate/frame-assembly share. Without
+                it the microworld is a predict-only storm whose GIL
+                contention inflates direct's per-call latency — it chose
+                batching for a host-bound model the real workload then lost
+                by 2x. With realistic gaps, predicts arrive sparsely, which
+                is exactly the arrival pattern the decision must survive."""
+                deadline = time.monotonic() + hostwork_s
+                count = 0
+                while time.monotonic() < deadline:
+                    count += 1
+
             def drive(fn) -> float:
+                """Median PER-CALL latency under thread concurrency with
+                host-work gaps.
+
+                Per-call latency, not aggregate wall: back-to-back walls
+                under-weight the queue/event sync each batched call pays.
+                Where the device call dominates — the regime batching
+                exists for — the fused call still wins per-call latency,
+                because direct dispatches serialize at the device while one
+                batch runs them together.
+                """
                 errors: List[BaseException] = []
+                times: List[float] = []
+                lock = threading.Lock()
 
                 def worker():
                     try:
-                        for _ in range(rounds):
+                        for r in range(rounds):
+                            if r:
+                                host_work()  # inter-call gap only, no
+                                # dead spin after the final sample
+                            t0 = time.monotonic()
                             fn()
+                            elapsed = time.monotonic() - t0
+                            with lock:
+                                times.append(elapsed)
                     except BaseException as exc:  # noqa: BLE001
                         errors.append(exc)
 
                 threads = [
                     threading.Thread(target=worker) for _ in range(users)
                 ]
-                t0 = time.monotonic()
                 for t in threads:
                     t.start()
                 for t in threads:
                     t.join()
                 if errors:
                     raise errors[0]
-                return time.monotonic() - t0
+                times.sort()
+                return times[len(times) // 2]
 
             # warm both arms (XLA compiles, param-bank stack) before timing
             direct(params, np.asarray(X))
             self._force_submit(spec, params, X)
             drive(lambda: self._force_submit(spec, params, X))
 
-            wall_direct = drive(lambda: direct(params, np.asarray(X)))
-            wall_batched = drive(lambda: self._force_submit(spec, params, X))
-            won = wall_batched < wall_direct
+            p50_direct = drive(lambda: direct(params, np.asarray(X)))
+            p50_batched = drive(lambda: self._force_submit(spec, params, X))
+            won = p50_batched < p50_direct
+            arch = "/".join(
+                sorted({type(layer).__name__ for layer in spec.layers})
+            )
             logger.info(
-                "serving batcher self-A/B for %s models (%d users x %d "
-                "rounds): direct %.1fms, batched %.1fms -> batching %s",
-                type(spec.layers[0]).__name__ if spec.layers else "?",
-                users, rounds, wall_direct * 1e3, wall_batched * 1e3,
+                "serving batcher self-A/B for %s (lookback %d) models "
+                "(%d users x %d rounds): per-call p50 direct %.2fms, "
+                "batched %.2fms -> batching %s",
+                arch or "?", spec.lookback_window,
+                users, rounds, p50_direct * 1e3, p50_batched * 1e3,
                 "ON" if won else "OFF (stood down: fused call loses to "
                 "per-request dispatch on this backend)",
             )
