@@ -219,6 +219,55 @@ def servers_dashboard() -> Dict[str, Any]:
             y=2 * _PANEL_H,
             unit="percentunit",
         ),
+        _timeseries(
+            "Cross-model batcher (cumulative)",
+            # gauges mirrored from the batcher's monotone totals — plotted
+            # raw, not rate(): gauge semantics
+            [
+                {
+                    "expr": f"sum(gordo_server_batcher_items{{{_SEL}}})",
+                    "legend": "batched predicts",
+                },
+                {
+                    "expr": (
+                        f"sum(gordo_server_batcher_device_calls{{{_SEL}}})"
+                    ),
+                    "legend": "fused device calls",
+                },
+                {
+                    "expr": (
+                        f"max(gordo_server_batcher_largest_batch{{{_SEL}}})"
+                    ),
+                    "legend": "largest batch",
+                },
+            ],
+            panel_id=8,
+            x=0,
+            y=3 * _PANEL_H,
+            description=(
+                "Predicts fused into shared device calls; flat lines mean "
+                "the self-A/B stood batching down on this backend"
+            ),
+        ),
+        _timeseries(
+            "Batcher self-A/B decisions",
+            [
+                {
+                    "expr": (
+                        f"sum(gordo_server_batcher_specs{{{_SEL}}}) "
+                        "by (decision)"
+                    ),
+                    "legend": "{{decision}}",
+                }
+            ],
+            panel_id=9,
+            x=_PANEL_W,
+            y=3 * _PANEL_H,
+            description=(
+                "Architectures whose measured startup A/B kept batching on "
+                "('batch') vs stood down to per-request dispatch ('direct')"
+            ),
+        ),
     ]
     return _dashboard("Gordo TPU servers", "gordo-tpu-servers", panels)
 

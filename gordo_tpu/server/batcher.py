@@ -193,6 +193,13 @@ class CrossModelBatcher:
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
 
     # ------------------------------------------------------------- public
+    def decision_counts(self) -> Tuple[int, int]:
+        """(architectures batching, architectures stood down) — the public
+        snapshot the metrics mirror reads (prometheus/metrics.py)."""
+        decisions = list(self._spec_on.values())
+        on = sum(1 for d in decisions if d)
+        return on, len(decisions) - on
+
     def submit(self, spec, params, X) -> Optional[np.ndarray]:
         """Blocking predict through the batch queue (thread-safe).
 
@@ -424,6 +431,12 @@ class CrossModelBatcher:
 # ------------------------------------------------------------ global switch
 _batcher: Optional[CrossModelBatcher] = None
 _batcher_lock = threading.Lock()
+
+
+def peek_batcher() -> Optional[CrossModelBatcher]:
+    """The process batcher if one exists — never creates one (observability
+    callers must not flip batching on as a side effect)."""
+    return _batcher
 
 
 def get_batcher() -> Optional[CrossModelBatcher]:

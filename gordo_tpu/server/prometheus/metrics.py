@@ -91,6 +91,57 @@ class GordoServerPrometheusMetrics:
             multiprocess_mode="liveall",
         )
         self.version_info.labels(version=__version__, project=self.project).set(1)
+        # cross-model batcher observability (server/batcher.py): fused-call
+        # totals plus how many architectures the measured self-A/B kept
+        # batching for vs stood down. livesum: per-worker batchers sum.
+        self.batcher_items = Gauge(
+            "gordo_server_batcher_items",
+            "Predicts that went through the cross-model batcher",
+            ["project"],
+            registry=metric_registry,
+            multiprocess_mode="livesum",
+        )
+        self.batcher_device_calls = Gauge(
+            "gordo_server_batcher_device_calls",
+            "Fused device calls the batcher executed",
+            ["project"],
+            registry=metric_registry,
+            multiprocess_mode="livesum",
+        )
+        self.batcher_largest_batch = Gauge(
+            "gordo_server_batcher_largest_batch",
+            "Largest fused batch observed",
+            ["project"],
+            registry=metric_registry,
+            multiprocess_mode="max",
+        )
+        self.batcher_specs = Gauge(
+            "gordo_server_batcher_specs",
+            "Architectures by self-A/B decision (batching on/stood down)",
+            ["project", "decision"],
+            registry=metric_registry,
+            # max, not livesum: every worker calibrates the same spec set,
+            # so summing would multiply the architecture count by the
+            # worker count
+            multiprocess_mode="max",
+        )
+        # labeled children resolved once: record() runs per request and
+        # .labels() takes the metric lock each call
+        self._batcher_children = {
+            "items": self.batcher_items.labels(project=self.project),
+            "device_calls": self.batcher_device_calls.labels(
+                project=self.project
+            ),
+            "largest_batch": self.batcher_largest_batch.labels(
+                project=self.project
+            ),
+            "on": self.batcher_specs.labels(
+                project=self.project, decision="batch"
+            ),
+            "off": self.batcher_specs.labels(
+                project=self.project, decision="direct"
+            ),
+        }
 
     def record(self, request, response, start_time: float):
         """Record one request; ``start_time`` is the caller's local
@@ -108,6 +159,23 @@ class GordoServerPrometheusMetrics:
         )
         self.request_duration.labels(**labels).observe(duration)
         self.request_count.labels(**labels).inc()
+        self._refresh_batcher()
+
+    def _refresh_batcher(self):
+        """Mirror the process batcher's counters into gauges (peek only —
+        never creates a batcher as an observability side effect)."""
+        from gordo_tpu.server.batcher import peek_batcher
+
+        batcher = peek_batcher()
+        if batcher is None:
+            return
+        children = self._batcher_children
+        children["items"].set(batcher.stats["items"])
+        children["device_calls"].set(batcher.stats["device_calls"])
+        children["largest_batch"].set(batcher.stats["largest_batch"])
+        on, off = batcher.decision_counts()
+        children["on"].set(on)
+        children["off"].set(off)
 
     def expose(self) -> bytes:
         return generate_latest(self.registry)

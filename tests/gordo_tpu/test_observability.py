@@ -24,6 +24,10 @@ def test_dashboards_reference_live_metric_names():
         "gordo_server_request_duration_seconds",
         "gordo_server_requests_total",
         "gordo_server_info",
+        "gordo_server_batcher_items",
+        "gordo_server_batcher_device_calls",
+        "gordo_server_batcher_largest_batch",
+        "gordo_server_batcher_specs",
     }
     # the exported set itself must match what metrics.py registers
     src = open(server_metrics.__file__).read()

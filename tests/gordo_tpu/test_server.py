@@ -296,3 +296,47 @@ def test_openapi_spec_matches_url_map(client):
     }
     spec_paths = set(spec["paths"])
     assert rule_paths <= spec_paths, rule_paths - spec_paths
+
+
+def test_prometheus_batcher_metrics(
+    model_collection_directory, trained_model_directories, monkeypatch
+):
+    """The batcher's counters and self-A/B decisions surface as gauges."""
+    import json
+    import threading
+
+    from gordo_tpu.server import batcher as batcher_mod
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    app = build_app(
+        {
+            "MODEL_COLLECTION_DIR": model_collection_directory,
+            "ENABLE_PROMETHEUS": True,
+            "PROJECT": "test-proj",
+        }
+    )
+    client = app.test_client()
+    machine = "machine-1"
+    n_tags = 4
+    X = np.random.RandomState(0).rand(20, n_tags).tolist()
+    body = json.dumps({"X": X, "y": X}).encode()
+    path = f"/gordo/v0/test-proj/{machine}/prediction"
+
+    def post():
+        resp = client.post(path, data=body, content_type="application/json")
+        assert resp.status_code == 200
+
+    post()  # warm (model load + compile)
+    threads = [threading.Thread(target=post) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    text = app._prometheus.expose().decode()
+    assert "gordo_server_batcher_items" in text
+    assert "gordo_server_batcher_device_calls" in text
+    stats = batcher_mod._batcher.stats
+    assert stats["items"] >= 5
+    assert f'gordo_server_batcher_items{{project="test-proj"}} {float(stats["items"])}' in text
