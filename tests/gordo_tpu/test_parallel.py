@@ -545,3 +545,24 @@ def test_kfold_kfcv_batched_build_matches_serial_builder():
         serial_model.feature_thresholds_
     )
     assert np.all((feat_ratio > 1 / 3) & (feat_ratio < 3)), feat_ratio
+
+
+def test_plain_detector_kfold_builds_via_serial_path():
+    """A non-KFCV detector with a KFold cv config is rejected by the planner
+    (rolling thresholds need contiguous folds) but must still BUILD through
+    the serial ModelBuilder — capability is never lost, only speed."""
+    from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+
+    machines = _machines("machines:" + _machine_block("plain-kf-build"))
+    machines[0].evaluation["cv"] = {
+        "sklearn.model_selection.KFold": {
+            "n_splits": 3, "shuffle": True, "random_state": 0,
+        }
+    }
+    [(model, machine_out)] = BatchedModelBuilder(machines).build()
+    assert isinstance(model, DiffBasedAnomalyDetector)
+    assert np.isfinite(model.aggregate_threshold_)
+    splits = machine_out.metadata.build_metadata.model.cross_validation.splits
+    assert splits["fold-1-n-test"] > 0
+    scores = machine_out.metadata.build_metadata.model.cross_validation.scores
+    assert any("r2" in key for key in scores)
