@@ -113,6 +113,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         "remat",
         "pipeline_parallel",
         "expert_parallel",
+        "data_parallel",
     )
 
     def _factory_kwargs(self):
@@ -174,6 +175,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
             spec = prepare_ep_spec(
                 dataclasses.replace(spec, expert_parallel=expert_parallel)
+            )
+        data_parallel = int(self.kwargs.get("data_parallel", 0) or 0)
+        if data_parallel > 1:
+            from gordo_tpu.parallel.data_parallel import prepare_dp_spec
+
+            spec = prepare_dp_spec(
+                dataclasses.replace(spec, data_parallel=data_parallel)
             )
         return spec
 

@@ -169,6 +169,11 @@ class ModelSpec:
     # shard MoE expert weights over an N-chip `expert` mesh axis
     # (parallel/expert_parallel.py). 0/1 = all experts on every chip
     expert_parallel: int = 0
+    # shard THIS machine's training batch over an N-chip `data` mesh axis
+    # (parallel/data_parallel.py): params replicated, activations/grads
+    # split, one GSPMD gradient all-reduce per step. The within-machine
+    # form of the fleet's across-machines data parallelism. 0/1 = off
+    data_parallel: int = 0
 
     @property
     def is_recurrent(self) -> bool:

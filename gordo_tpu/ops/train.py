@@ -110,6 +110,9 @@ def make_epoch_fn(
     n_steps = max((n_samples + batch_size - 1) // batch_size, 1)
     n_pad = n_steps * batch_size
     opt = make_optimizer(spec.optimizer)
+    from gordo_tpu.parallel.data_parallel import batch_constraint, dp_degree
+
+    dp = dp_degree(spec)
 
     def epoch(params, opt_state, X, y, rng):
         base_idx = jnp.arange(n_samples)
@@ -128,6 +131,10 @@ def make_epoch_fn(
             idx = jax.lax.dynamic_slice(idx_stream, (i * batch_size,), (batch_size,))
             wb = jax.lax.dynamic_slice(w_stream, (i * batch_size,), (batch_size,))
             xb, yb = _gather_batch(spec, X, y, idx)
+            if dp > 1:
+                # batch axis split over the `data` mesh: GSPMD partitions
+                # fwd/bwd and all-reduces the grads (params replicated)
+                xb, yb, wb = batch_constraint(spec, xb, yb, wb)
             loss, grads = jax.value_and_grad(_loss_terms, argnums=1)(
                 spec, params, xb, yb, wb
             )
@@ -362,10 +369,28 @@ def fit_arrays(
             f"{spec.lookback_window} lookahead={spec.lookahead}"
         )
     batch_size = min(batch_size, max(n_samples, 1))
+    from gordo_tpu.parallel.data_parallel import (
+        dp_degree,
+        dp_mesh,
+        replicate_params_dp,
+    )
     from gordo_tpu.parallel.expert_parallel import ep_degree, shard_params_ep
     from gordo_tpu.parallel.pipeline_parallel import pp_degree, pp_mesh
     from gordo_tpu.parallel.tensor_parallel import shard_params_tp, tp_degree
 
+    dp = dp_degree(spec)
+    if dp > 1:
+        dp_mesh(dp)  # training claims capacity: fail loudly on small hosts
+        if batch_size % dp:
+            if batch_size < dp:
+                raise ValueError(
+                    f"data_parallel={dp} but the effective batch size is "
+                    f"{batch_size}; the split needs at least one sample "
+                    f"per chip"
+                )
+            # round down so every chip gets equal batch slices
+            batch_size -= batch_size % dp
+        params = replicate_params_dp(spec, params)
     pp = pp_degree(spec)
     if pp > 1 and batch_size % pp:
         # the clamp above can break the divisibility fit() validated; a

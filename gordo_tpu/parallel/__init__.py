@@ -18,6 +18,7 @@ from .ring_attention import make_ring_attention, sequence_sharding
 from .tensor_parallel import prepare_tp_spec, shard_params_tp, tp_mesh
 from .pipeline_parallel import make_pipeline_blocks_fn, prepare_pp_spec, pp_mesh
 from .expert_parallel import ep_mesh, prepare_ep_spec
+from .data_parallel import dp_mesh, prepare_dp_spec
 
 __all__ = [
     "default_mesh",
@@ -33,4 +34,6 @@ __all__ = [
     "pp_mesh",
     "ep_mesh",
     "prepare_ep_spec",
+    "dp_mesh",
+    "prepare_dp_spec",
 ]

@@ -255,13 +255,20 @@ def _plan_machine(machine: Machine) -> Optional[_Plan]:
         # ring attention is shard_map over the whole mesh — it cannot run
         # under this builder's vmap-over-machines; serial path owns it
         return None
+    from gordo_tpu.parallel.data_parallel import dp_degree
     from gordo_tpu.parallel.expert_parallel import ep_degree
     from gordo_tpu.parallel.pipeline_parallel import pp_degree
     from gordo_tpu.parallel.tensor_parallel import tp_degree
 
-    if tp_degree(spec) > 1 or pp_degree(spec) > 1 or ep_degree(spec) > 1:
-        # model-axis-sharded params / the pipeline's or expert shard_map
-        # claim the mesh for ONE machine; the serial path owns such machines
+    if (
+        tp_degree(spec) > 1
+        or pp_degree(spec) > 1
+        or ep_degree(spec) > 1
+        or dp_degree(spec) > 1
+    ):
+        # model-axis-sharded params / the pipeline's or expert shard_map /
+        # a batch sharded over the data mesh all claim the mesh for ONE
+        # machine; the serial path owns such machines
         return None
 
     return _Plan(

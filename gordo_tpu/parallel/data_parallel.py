@@ -1,0 +1,125 @@
+"""
+Within-machine data parallelism: shard one model's BATCH over the mesh.
+
+The fleet trainer is data parallelism *across* machines (one model per
+vmap lane); this axis is the classic form *within* one machine — for a
+single model trained on more rows than one chip chews comfortably
+(`data_parallel: N` in the model config). The reference has neither form
+(single-model Keras fit per pod, SURVEY §2).
+
+TPU-first mechanics: no manual collectives and no per-device code. Params
+are committed REPLICATED on a 1-D ``data`` mesh and each minibatch gets a
+``with_sharding_constraint`` splitting its batch axis across the chips;
+GSPMD then partitions the forward/backward and inserts exactly one
+gradient all-reduce per step over ICI. The same `make_epoch_fn` program
+runs unmodified — sharding is a placement annotation, so the math is the
+single-device program's up to reduction order.
+
+Interplay with the other axes: dp claims the whole mesh for one machine,
+so dp specs take the serial builder path and stay off the vmap paths
+(same policy as ring/TP/PP/EP); combining with tensor/pipeline/expert
+axes would need a 2-D mesh and is rejected at spec build.
+"""
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gordo_tpu.models.spec import ModelSpec
+from .mesh import axis_mesh
+
+AXIS = "data"
+
+
+def dp_degree(spec: Any) -> int:
+    """The spec's data-parallel shard count (0/1 = off); pickle-tolerant."""
+    return int(getattr(spec, "data_parallel", 0) or 0)
+
+
+def prepare_dp_spec(spec: ModelSpec) -> ModelSpec:
+    """Validate a data-parallel spec at build time."""
+    from gordo_tpu.models.spec import TransformerBlock
+    from gordo_tpu.ops.attention import spec_may_use_ring
+
+    dp = dp_degree(spec)
+    if dp <= 1:
+        return spec
+    for other in ("tensor_parallel", "pipeline_parallel", "expert_parallel"):
+        if int(getattr(spec, other, 0) or 0) > 1:
+            raise ValueError(
+                f"data_parallel and {other} cannot combine on one spec "
+                f"yet — pick one mesh axis per model"
+            )
+    if spec_may_use_ring(spec):
+        # ring's `seq` shard_map and the `data` batch split are two
+        # different meshes inside one jitted step — fail here with the
+        # other axes' clear build-time error, not deep inside jit at fit
+        raise ValueError(
+            "data_parallel and attention='ring' cannot combine on one "
+            "spec yet — pick one mesh axis per model"
+        )
+    import dataclasses
+
+    layers = []
+    changed = False
+    for layer in spec.layers:
+        if isinstance(layer, TransformerBlock):
+            if layer.attention_impl == "flash":
+                raise ValueError(
+                    "attention='flash' cannot run under data_parallel "
+                    "(single-device kernel vs a GSPMD-split batch); use "
+                    "attention='xla' (or 'auto') with data_parallel"
+                )
+            if layer.attention_impl != "xla":
+                # pin auto->xla so a runtime env override (ring threshold,
+                # flash) can't smuggle an unpartitionable impl under the
+                # data mesh — same policy as tensor_parallel
+                layer = dataclasses.replace(layer, attention_impl="xla")
+                changed = True
+        layers.append(layer)
+    if changed:
+        spec = dataclasses.replace(spec, layers=tuple(layers))
+    return spec
+
+
+def dp_mesh(n_shards: int) -> Mesh:
+    """A 1-D ``data`` mesh over the first ``n_shards`` addressable devices."""
+    return axis_mesh(AXIS, n_shards, "data_parallel")
+
+
+def replicate_params_dp(spec: ModelSpec, params):
+    """Commit params replicated on the ``data`` mesh (no-op when dp is off).
+
+    Replication is the dp placement: every chip holds the full weights and
+    optimizer state; only activations/grads split. Committing up front
+    keeps XLA from re-deciding placement per step.
+    """
+    dp = dp_degree(spec)
+    if dp <= 1:
+        return params
+    mesh = dp_mesh(dp)
+    return jax.device_put(
+        params, jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), params)
+    )
+
+
+def batch_constraint(spec: ModelSpec, xb, yb, wb):
+    """Annotate one minibatch with batch-axis sharding over the data mesh.
+
+    Called inside the jitted epoch body (ops/train.make_epoch_fn); GSPMD
+    propagates the split through the forward/backward and all-reduces the
+    gradients. Dense minibatches are (B, D); windowed ones (B, L, D).
+    """
+    dp = dp_degree(spec)
+    if dp <= 1:
+        return xb, yb, wb
+    mesh = dp_mesh(dp)
+
+    def constrain(arr):
+        spec_dims = P(AXIS, *([None] * (arr.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec_dims)
+        )
+
+    return constrain(xb), constrain(yb), constrain(wb)

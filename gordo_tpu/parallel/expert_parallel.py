@@ -67,16 +67,12 @@ def prepare_ep_spec(spec: ModelSpec) -> ModelSpec:
     return spec
 
 
-@functools.lru_cache(maxsize=8)
 def ep_mesh(n_shards: int) -> Mesh:
-    """A 1-D ``expert`` mesh over the first ``n_shards`` addressable devices."""
-    devices = jax.local_devices()
-    if n_shards > len(devices):
-        raise ValueError(
-            f"expert_parallel={n_shards} but only {len(devices)} "
-            f"addressable device(s) ({devices[0].platform})"
-        )
-    return Mesh(devices[:n_shards], (AXIS,))
+    """A 1-D ``expert`` mesh over the first ``n_shards`` addressable devices
+    (shared builder: parallel/mesh.axis_mesh)."""
+    from .mesh import axis_mesh
+
+    return axis_mesh(AXIS, n_shards, "expert_parallel")
 
 
 def ep_shardings(spec: ModelSpec, params, mesh: Mesh):

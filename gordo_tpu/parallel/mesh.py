@@ -10,6 +10,7 @@ only its addressable shards, and XLA handles ICI/DCN placement — see
 ``parallel/distributed.py`` and ``tests/gordo_tpu/test_distributed.py``.
 """
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -23,6 +24,24 @@ def default_mesh(
     """A 1-D mesh over all (or the given) devices."""
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis_name,))
+
+
+@functools.lru_cache(maxsize=32)
+def axis_mesh(axis: str, n_shards: int, knob: str) -> Mesh:
+    """A 1-D per-model mesh over the first ``n_shards`` *addressable*
+    devices — the shared builder behind every single-model scaling axis
+    (model/pipe/expert/data). Local by design: in a multiprocess fleet a
+    per-model-axis machine is owned by one process (serial fallback),
+    whose single-process placement could not execute collectively over
+    other hosts' chips. ``knob`` names the config field in the capacity
+    error."""
+    devices = jax.local_devices()
+    if n_shards > len(devices):
+        raise ValueError(
+            f"{knob}={n_shards} but only {len(devices)} addressable "
+            f"device(s) ({devices[0].platform})"
+        )
+    return Mesh(devices[:n_shards], (axis,))
 
 
 def machines_sharding(mesh: Mesh, axis_name: str = "machines") -> NamedSharding:

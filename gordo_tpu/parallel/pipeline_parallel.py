@@ -110,16 +110,12 @@ def prepare_pp_spec(spec: ModelSpec) -> ModelSpec:
     return replace(spec, layers=tuple(layers))
 
 
-@functools.lru_cache(maxsize=8)
 def pp_mesh(n_stages: int) -> Mesh:
-    """A 1-D ``pipe`` mesh over the first ``n_stages`` addressable devices."""
-    devices = jax.local_devices()
-    if n_stages > len(devices):
-        raise ValueError(
-            f"pipeline_parallel={n_stages} but only {len(devices)} "
-            f"addressable device(s) ({devices[0].platform})"
-        )
-    return Mesh(devices[:n_stages], (AXIS,))
+    """A 1-D ``pipe`` mesh over the first ``n_stages`` addressable devices
+    (shared builder: parallel/mesh.axis_mesh)."""
+    from .mesh import axis_mesh
+
+    return axis_mesh(AXIS, n_stages, "pipeline_parallel")
 
 
 @functools.lru_cache(maxsize=32)

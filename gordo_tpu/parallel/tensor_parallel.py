@@ -28,7 +28,6 @@ Interplay with the other axes:
   rejects explicit ``flash``/``ring``.
 """
 
-import functools
 import logging
 from dataclasses import replace
 from typing import Any, Optional
@@ -91,20 +90,13 @@ def prepare_tp_spec(spec: ModelSpec) -> ModelSpec:
     return replace(spec, layers=tuple(layers))
 
 
-@functools.lru_cache(maxsize=8)
 def tp_mesh(n_shards: int) -> Mesh:
     """A 1-D ``model`` mesh over the first ``n_shards`` *addressable*
-    devices. Local by design: in a multiprocess fleet a TP machine is owned
-    by one process (serial fallback), whose single-process ``device_put``
-    could not execute collectively over other hosts' chips."""
-    devices = jax.local_devices()
-    if n_shards > len(devices):
-        raise ValueError(
-            f"tensor_parallel={n_shards} but only {len(devices)} addressable "
-            f"device(s) ({devices[0].platform}); multi-chip TP needs a mesh "
-            f"of at least that many chips"
-        )
-    return Mesh(devices[:n_shards], (AXIS,))
+    devices (shared builder: parallel/mesh.axis_mesh — local by design;
+    a TP machine is owned by one process on the serial fallback path)."""
+    from .mesh import axis_mesh
+
+    return axis_mesh(AXIS, n_shards, "tensor_parallel")
 
 
 def tp_shardings(spec: ModelSpec, params, mesh: Mesh):

@@ -484,12 +484,19 @@ def maybe_submit(spec, params, X) -> Optional[np.ndarray]:
         # ring attention (shard_map) cannot run under this batcher's
         # vmap-over-models; such specs always predict direct
         return None
+    from gordo_tpu.parallel.data_parallel import dp_degree
     from gordo_tpu.parallel.expert_parallel import ep_degree
     from gordo_tpu.parallel.pipeline_parallel import pp_degree
     from gordo_tpu.parallel.tensor_parallel import tp_degree
 
-    if tp_degree(spec) > 1 or pp_degree(spec) > 1 or ep_degree(spec) > 1:
-        # tensor-parallel params are sharded over the mesh, and the
-        # pipeline/expert shard_maps can't nest under vmap — predict direct
+    if (
+        tp_degree(spec) > 1
+        or pp_degree(spec) > 1
+        or ep_degree(spec) > 1
+        or dp_degree(spec) > 1
+    ):
+        # tensor-parallel params are sharded over the mesh, the
+        # pipeline/expert shard_maps can't nest under vmap, and dp params
+        # live replicated on their own mesh — predict direct
         return None
     return batcher.submit(spec, params, X)
