@@ -1,8 +1,9 @@
 """
-The five scaling axes, each driven from plain model config, on an
+The six scaling axes, each driven from plain model config, on an
 8-virtual-device CPU mesh (the same code paths a TPU slice runs):
 
     dp  — a fleet of machines trained as ONE vmapped XLA program
+    dp1 — data parallelism within ONE machine: its batch sharded over the mesh
     sp  — ring attention: the lookback window sharded over the mesh
     tp  — tensor parallelism: Megatron-sharded Transformer weights
     pp  — pipeline parallelism: GPipe microbatches through block stages
@@ -68,6 +69,19 @@ def main():
     results = BatchedModelBuilder(fleet).build()
     print(f"dp: {len(results)} machines trained in one vmapped program")
 
+    # ---- dp within one machine: batch sharded, params replicated, one
+    # GSPMD gradient all-reduce per step (parallel/data_parallel.py)
+    from gordo_tpu.models.models import AutoEncoder
+
+    big = rng.rand(32 * N, 4).astype(np.float32)
+    one = AutoEncoder(
+        kind="feedforward_hourglass", epochs=1, batch_size=8 * N,
+        data_parallel=N,
+    )
+    one.fit(big, big)
+    assert np.isfinite(one.predict(big[:16])).all()
+    print(f"dp1: one machine's batch sharded over {N} devices")
+
     # ---- the per-model axes, each a plain config knob
     axes = {
         "sp (attention: ring)": {
@@ -103,7 +117,7 @@ def main():
         assert np.isfinite(pred).all()
         print(f"{label}: trained + predicted, output {pred.shape}")
 
-    print("all five scaling axes ran from config")
+    print("all six scaling axes ran from config")
 
 
 if __name__ == "__main__":

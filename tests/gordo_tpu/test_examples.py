@@ -38,7 +38,7 @@ def _run_example(script: str, timeout: int) -> str:
             "full YAML -> build -> serve -> predict loop complete",
             600,
         ),
-        ("parallel_axes.py", "all five scaling axes ran from config", 900),
+        ("parallel_axes.py", "all six scaling axes ran from config", 900),
     ],
 )
 def test_example_runs(script, sentinel, timeout):
