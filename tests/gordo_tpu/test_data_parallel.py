@@ -140,3 +140,20 @@ def test_dp_rejects_ring_and_pins_flash():
         for layer in spec.layers
         if isinstance(layer, TransformerBlock)
     )
+
+
+def test_dp_artifact_pickle_roundtrip():
+    """dp-trained params (mesh-replicated jax arrays) pickle to host numpy
+    and serve anywhere — replication needs no reshard-on-load path."""
+    import pickle
+
+    X = _data(n=128, seed=3)
+    model = AutoEncoder(
+        kind="feedforward_hourglass", epochs=1, batch_size=64, data_parallel=8
+    )
+    model.fit(X, X)
+    expected = model.predict(X[:16])
+    blob = pickle.dumps(model)
+    loaded = pickle.loads(blob)
+    out = loaded.predict(X[:16])
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
