@@ -269,6 +269,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
         X = self._as_2d_array(X)
         from gordo_tpu.parallel.expert_parallel import ep_degree, shard_params_ep
+        from gordo_tpu.parallel.pipeline_parallel import pp_degree
         from gordo_tpu.parallel.tensor_parallel import maybe_reshard_params, tp_degree
 
         if tp_degree(self.spec_) > 1:
@@ -282,14 +283,29 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         ):
             # non-strict: a small serving host degrades to all-local expert
             # dispatch instead of erroring (parallel/expert_parallel.py).
-            # A failed reshard is remembered — params stay host numpy there,
-            # and retrying (plus re-warning) on every predict would tax the
-            # serving hot path for a deterministic outcome
+            # A failed reshard is remembered so it is not retried (and
+            # re-warned) per predict; the plain device commit below then
+            # applies — the degraded dispatch is single-device anyway
             resharded = shard_params_ep(self.spec_, self.params_, strict=False)
             if resharded is self.params_:
                 self._ep_reshard_failed = True
             else:
                 self.params_ = resharded
+        from gordo_tpu.ops.attention import spec_may_use_ring
+
+        if (
+            self._params_on_host()
+            and pp_degree(self.spec_) <= 1
+            and not spec_may_use_ring(self.spec_)
+        ):
+            # artifact-loaded params are host numpy, and jit RE-STAGES host
+            # arguments on every call — on an accelerator that is a full
+            # param re-upload per request. Commit once; every subsequent
+            # predict passes device-resident jax.Arrays. TP/EP mesh cases
+            # were handled above; PP and ring specs are EXCLUDED — their
+            # predict programs shard_map over their own mesh, and a
+            # single-device commitment would conflict with it
+            self.params_ = jax.device_put(self.params_)
         # serving: concurrent predicts across models fuse into one device
         # call when the cross-model batcher is enabled (server/batcher.py)
         from gordo_tpu.server.batcher import maybe_submit

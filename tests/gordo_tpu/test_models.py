@@ -337,3 +337,28 @@ def test_remat_roundtrips_through_definition():
     model = from_definition(d)
     back = into_definition(model)
     assert back["gordo_tpu.models.models.TransformerAutoEncoder"]["remat"] is True
+
+
+def test_artifact_params_committed_to_device_once():
+    """Artifact-loaded (pickled) params are host numpy; the first predict
+    must commit them to device so later jitted calls stop re-staging the
+    whole pytree per request — on an accelerator that re-upload was the
+    serving p50."""
+    import pickle
+
+    import jax
+
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    X = np.random.RandomState(5).rand(64, 4).astype(np.float32)
+    model.fit(X, X)
+    loaded = pickle.loads(pickle.dumps(model))
+    assert all(
+        isinstance(leaf, np.ndarray)
+        for leaf in jax.tree_util.tree_leaves(loaded.params_)
+    )
+    out1 = loaded.predict(X[:16])
+    assert all(
+        isinstance(leaf, jax.Array)
+        for leaf in jax.tree_util.tree_leaves(loaded.params_)
+    )
+    np.testing.assert_allclose(out1, model.predict(X[:16]), rtol=1e-5, atol=1e-6)
