@@ -455,3 +455,18 @@ def test_adls_explicit_credential_beats_stale_env(monkeypatch):
     provider = DataLakeProvider(store_name="acct", account_key=key)
     assert provider.sas_token is None
     assert provider.account_key == key
+
+
+def test_adls_missing_file_raises_ioerror():
+    from gordo_tpu.dataset.data_provider import DataLakeProvider
+    from gordo_tpu.dataset.sensor_tag import SensorTag
+
+    provider = DataLakeProvider(
+        store_name="acct", sas_token="sig=x", session=_ADLSStub({})
+    )
+    with pytest.raises(IOError, match="ADLS read failed.*404"):
+        list(provider.load_series(
+            pd.Timestamp("2019-01-01", tz="UTC"),
+            pd.Timestamp("2019-01-02", tz="UTC"),
+            [SensorTag("absent", "plant")],
+        ))
