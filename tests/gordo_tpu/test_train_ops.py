@@ -1,0 +1,103 @@
+"""
+The training engine's two epoch programs (ops/train.py) must be the same
+math: the mask-padded, live-steps-bounded epoch (the fused CV program's
+body, rewritten to a lax.while_loop in round 4) against the plain scan
+epoch, and against itself across n_valid values.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.models.models import AutoEncoder, LSTMAutoEncoder
+from gordo_tpu.ops.nn import init_model_params
+from gordo_tpu.ops.train import (
+    make_epoch_fn,
+    make_masked_epoch_fn,
+    make_optimizer,
+)
+
+
+def _setup(est, n_rows=96, n_tags=4, seed=0):
+    spec = est.build_spec(n_tags, n_tags)
+    rng = np.random.RandomState(seed)
+    X = jnp.asarray(rng.rand(n_rows, n_tags).astype(np.float32))
+    params = init_model_params(jax.random.PRNGKey(seed), spec)
+    opt_state = make_optimizer(spec.optimizer).init(params)
+    return spec, params, opt_state, X
+
+
+@pytest.mark.parametrize(
+    "est",
+    [
+        AutoEncoder(kind="feedforward_hourglass"),
+        LSTMAutoEncoder(
+            kind="lstm_symmetric", dims=[8], funcs=["tanh"], lookback_window=8
+        ),
+    ],
+    ids=["dense", "windowed"],
+)
+def test_masked_epoch_fully_live_matches_plain_epoch(est):
+    """With n_valid == n_max and shuffle off, the masked while_loop epoch
+    must reproduce the plain scan epoch to fusion-level precision (XLA
+    compiles the two bodies differently, so last-ulp reassociation is
+    expected) — the live-steps bound changes the schedule, never the
+    math."""
+    from gordo_tpu.ops.train import n_train_samples
+
+    spec, params, opt_state, X = _setup(est)
+    n = n_train_samples(spec, X.shape[0])
+    batch = 32
+    rng_key = jax.random.PRNGKey(7)
+
+    plain = jax.jit(make_epoch_fn(spec, n, batch, shuffle=False))
+    masked = jax.jit(make_masked_epoch_fn(spec, n, batch, shuffle=False))
+
+    p1, o1, loss1 = plain(params, opt_state, X, X, rng_key)
+    p2, o2, loss2 = masked(params, opt_state, X, X, rng_key, jnp.asarray(n))
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_masked_epoch_short_fold_ignores_rows_past_prefix():
+    """A fold's epoch must see exactly its train-prefix rows: poisoning the
+    rows past n_valid with huge values must not change params or loss."""
+    est = AutoEncoder(kind="feedforward_hourglass")
+    spec, params, opt_state, X = _setup(est)
+    n_max = X.shape[0]
+    n_valid = 40
+    masked = jax.jit(make_masked_epoch_fn(spec, n_max, 32, shuffle=True))
+    rng_key = jax.random.PRNGKey(3)
+
+    p1, _, loss1 = masked(params, opt_state, X, X, rng_key, jnp.asarray(n_valid))
+    X_poison = X.at[n_valid:].set(1e6)
+    p2, _, loss2 = masked(
+        params, opt_state, X_poison, X_poison, rng_key, jnp.asarray(n_valid)
+    )
+    assert float(loss1) == float(loss2)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_masked_epoch_loss_is_live_sample_mean():
+    """The returned loss averages over live samples only (weight-padded
+    batches contribute nothing)."""
+    est = AutoEncoder(kind="feedforward_hourglass")
+    spec, params, opt_state, X = _setup(est)
+    masked = jax.jit(make_masked_epoch_fn(spec, X.shape[0], 32, shuffle=False))
+    rng_key = jax.random.PRNGKey(1)
+    # n_valid=33: two steps run (33 -> ceil(33/32)=2), second has 1 live row
+    _, _, loss = masked(params, opt_state, X, X, rng_key, jnp.asarray(33))
+    assert np.isfinite(float(loss))
+
+    # equivalent direct computation on the first 33 rows, batch order fixed
+    from gordo_tpu.ops.train import _loss_terms
+
+    l1 = _loss_terms(spec, params, X[:32], X[:32], jnp.ones(32))
+    # second step trains on updated params; just sanity-bound the epoch loss
+    assert 0.0 < float(loss) < 10 * float(l1) + 1.0
