@@ -8,7 +8,7 @@ JAX/XLA-first: the model zoo is Flax, per-machine training is batched with
 server evaluates anomaly scores with XLA-compiled batched inference.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 
 def _parse_version(version: str):
