@@ -35,6 +35,14 @@ N_SERIAL = int(os.environ.get("BENCH_SERIAL_MACHINES", "3"))
 EPOCHS = int(os.environ.get("BENCH_EPOCHS", "5"))
 
 
+def _sig3(value):
+    """Round to 3 significant digits (MFU on a fleet of tiny models is
+    ~1e-7 — a fixed-decimal round would print a misleading 0.0)."""
+    if value is None:
+        return None
+    return float(f"{value:.3g}")
+
+
 def _machine_config(name: str) -> dict:
     return {
         "name": name,
@@ -348,7 +356,7 @@ def _bench_windowed() -> dict:
         )
         out[family] = {
             "flops_per_machine": machine_flops,
-            "mfu": round(mfu_val, 5) if mfu_val is not None else None,
+            "mfu": _sig3(mfu_val),
             "n_machines": N_WINDOWED,
             "lookback": LOOKBACK,
             "n_tags": WINDOWED_TAGS,
@@ -429,13 +437,44 @@ def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
         assert resp.status_code == 200
     times.sort()
     mean = statistics.fmean(times)
+    floor = _d2h_latency_floor_ms()
+    p50 = times[len(times) // 2] * 1e3
     return {
         "rounds": rounds,
         "samples_per_post": samples,
-        "p50_ms": round(times[len(times) // 2] * 1e3, 3),
+        "p50_ms": round(p50, 3),
         "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 3),
         "samples_per_sec": round(samples / mean, 1),
+        # every request must pull its predictions device->host; over the
+        # axon tunnel that single round trip has a fixed latency far above
+        # any host or device work in the path (measured below: ~70ms here
+        # vs microseconds on a TPU-VM-local device). Recording the floor
+        # separately keeps the p50 honest about what the FRAMEWORK costs
+        "d2h_floor_ms": floor,
+        "p50_net_of_floor_ms": round(p50 - floor, 3),
     }
+
+
+def _d2h_latency_floor_ms(n: int = 15) -> float:
+    """Median wall of pulling a FRESH trivial jit result to host — the
+    per-request latency floor the serving path cannot go below on this
+    backend (a fleet build amortizes it; a request-response server pays it
+    once per request)."""
+    import timeit
+
+    import jax
+    import numpy as np
+
+    fn = jax.jit(lambda a: a * 1.0)
+    x = jax.device_put(np.ones((8, 8), np.float32))
+    np.asarray(fn(x))  # compile + first pull
+    times = []
+    for _ in range(n):
+        start = timeit.default_timer()
+        np.asarray(fn(x))
+        times.append(timeit.default_timer() - start)
+    times.sort()
+    return round(times[n // 2] * 1e3, 3)
 
 
 def _run_section(name: str) -> dict:
@@ -674,6 +713,11 @@ def main():
         "mfu": head.get("mfu"),
         "server_samples_per_sec": serving.get("samples_per_sec"),
         "server_p50_anomaly_ms": serving.get("p50_ms"),
+        # fixed per-request device->host latency of this backend (the axon
+        # tunnel here is ~70ms/pull; a TPU-VM-local device is microseconds) —
+        # the framework's own per-request cost is p50 minus this floor
+        "server_d2h_floor_ms": serving.get("d2h_floor_ms"),
+        "server_p50_net_of_floor_ms": serving.get("p50_net_of_floor_ms"),
         "windowed": {
             "platform": windowed.get("platform"),
             "vs_torch": {
@@ -777,7 +821,7 @@ def _bench_headline() -> dict:
         "n_devices": len(jax.devices()),
         "device_kind": device_kind,
         "flops_per_machine": machine_flops,
-        "mfu": round(mfu_val, 5) if mfu_val is not None else None,
+        "mfu": _sig3(mfu_val),
     }
 
 
