@@ -27,6 +27,7 @@ import os
 import sys
 import time
 import warnings
+from typing import Optional
 
 warnings.filterwarnings("ignore")
 
@@ -477,7 +478,38 @@ def _d2h_latency_floor_ms(n: int = 15) -> float:
     return round(times[n // 2] * 1e3, 3)
 
 
-def _run_section(name: str) -> dict:
+def _wedge_degraded(section: dict) -> bool:
+    """Whether a section record looks tunnel-degraded: a CPU fallback, or
+    the watchdog's hang entry (structured ``hung`` flag). A deterministic
+    failure (non-zero exit, unparseable output) is NOT wedge-shaped —
+    re-running it on a healthy accelerator would just repeat the failure
+    under a multi-hour leash."""
+    if not section:
+        return False
+    return section.get("platform") == "cpu" or bool(section.get("hung"))
+
+
+def _degraded_sections(sections: dict) -> list:
+    """Section names the recovery pass should re-run (disabled sections are
+    empty and skipped)."""
+    return [n for n, s in sections.items() if _wedge_degraded(s)]
+
+
+def _rerun_improves(rerun: dict, original: dict) -> bool:
+    """Whether a recovery-pass rerun should replace the first-pass record.
+
+    An accelerated, error-free rerun always wins. A rerun that degraded to
+    CPU again (tunnel re-wedged) only wins when the original is an error
+    entry — a completed CPU measurement beats no measurement, but never
+    replaces one."""
+    if "error" in rerun or rerun.get("platform") is None:
+        return False
+    if rerun.get("platform") != "cpu":
+        return True
+    return "error" in original
+
+
+def _run_section(name: str, extra_env: Optional[dict] = None) -> dict:
     """Run one optional section as a subprocess with a wall-clock timeout.
 
     The child re-enters this file with ``--section NAME`` and prints
@@ -503,12 +535,16 @@ def _run_section(name: str) -> dict:
         # three drives (direct/batched/auto) x two archs, plus the probe
         # retry budget when the tunnel is wedged
         timeout = max(timeout, 3000)
+    env = None
+    if extra_env:
+        env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}}
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--section", name],
             capture_output=True,
             timeout=timeout,
             text=True,
+            env=env,
         )
     except subprocess.TimeoutExpired as exc:
         for stream in (exc.stderr, exc.stdout):
@@ -517,7 +553,10 @@ def _run_section(name: str) -> dict:
                     stream, bytes
                 ) else stream
                 sys.stderr.write(text[-2000:])
-        return {"error": f"section {name} hung past {timeout}s (device wedge?)"}
+        return {
+            "error": f"section {name} hung past {timeout}s (device wedge?)",
+            "hung": True,
+        }
     sys.stderr.write(proc.stderr[-2000:])
     if proc.returncode != 0:
         return {"error": f"section {name} exit {proc.returncode}: "
@@ -637,24 +676,36 @@ def _section_child(name: str) -> None:
     print(json.dumps(envelope))
 
 
-def _default_backend_alive(timeout_sec: int) -> bool:
+def _default_backend_alive(timeout_sec: int, require_accel: bool = False) -> bool:
     """
     Probe the default JAX backend in a subprocess with a hard timeout.
 
     The TPU tunnel in this environment can block indefinitely inside
     ``jax.devices()`` (it hangs rather than raising), which would stall the
     whole benchmark; a wedged backend must demote to CPU instead.
+
+    ``require_accel``: only count a NON-cpu default backend as alive — the
+    recovery pass uses this so a host that never had an accelerator (where
+    the cpu backend answers happily) doesn't pointlessly re-run every
+    section just to get the same CPU numbers back.
     """
     import subprocess
 
-    code = "import jax; jax.devices(); print('ok')"
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "print('ok' if d.platform != 'cpu' else 'cpu-only')"
+    )
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
             timeout=timeout_sec,
             capture_output=True,
         )
-        return proc.returncode == 0 and b"ok" in proc.stdout
+        if proc.returncode != 0:
+            return False
+        if require_accel:
+            return b"ok" in proc.stdout
+        return b"ok" in proc.stdout or b"cpu-only" in proc.stdout
     except subprocess.TimeoutExpired:
         return False
 
@@ -668,14 +719,103 @@ def main():
     # anywhere must not cost the whole record. Each child re-probes the
     # backend itself, so a tunnel that recovers mid-run gets used. A failed
     # section degrades to an error entry; the one-line contract always holds.
+    t_start = time.time()
+    accel_expected = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+
+    def shed_env(*prior: dict) -> dict:
+        # once ANY earlier section's full probe-retry budget established the
+        # tunnel is down (CPU fallback or hang), later sections shouldn't
+        # each re-burn ~10min of probing before their own fallback — one
+        # probe each still catches a mid-run recovery, and the recovery
+        # pass below catches late ones
+        if accel_expected and any(_wedge_degraded(s) for s in prior):
+            return {"BENCH_BACKEND_PROBE_RETRIES": os.environ.get(
+                "BENCH_BACKEND_PROBE_RETRIES_AFTER_FALLBACK", "1")}
+        return {}
+
     headline = _run_section("headline")
-    head = headline.get("result") or {}
     windowed = {}
     if os.environ.get("BENCH_WINDOWED", "1") != "0":
-        windowed = _run_section("windowed")
+        windowed = _run_section("windowed", extra_env=shed_env(headline))
     batch_ab = {}
     if os.environ.get("BENCH_BATCH_AB", "1") != "0":
-        batch_ab = _run_section("batch_ab")
+        batch_ab = _run_section(
+            "batch_ab", extra_env=shed_env(headline, windowed)
+        )
+
+    # First-pass record goes out IMMEDIATELY (file + both stdout lines): the
+    # recovery pass below can hold multi-hour section leashes, and a driver
+    # that times out mid-recovery must still find a complete record — losing
+    # already-computed results is the exact round-3 failure mode.
+    _emit_record(headline, windowed, batch_ab, [])
+
+    # Recovery pass: the round-3 postmortem's failure mode is a tunnel wedge
+    # at bench time surrendering the whole record to CPU. The wedge is
+    # usually transient — so if any section degraded (CPU fallback or hang)
+    # on a run that EXPECTED an accelerator, and the backend answers a probe
+    # now, re-run just those sections and adopt the recovered results. One
+    # pass, gated on elapsed wall so a tight driver timeout isn't blown.
+    recovered: list = []
+    recovery_budget = int(os.environ.get("BENCH_RECOVERY_MAX_ELAPSED", "10800"))
+    if accel_expected and os.environ.get("BENCH_RECOVERY", "1") != "0":
+        sections = {"headline": headline, "windowed": windowed,
+                    "batch_ab": batch_ab}
+        degraded = _degraded_sections(sections)
+        if degraded and time.time() - t_start >= recovery_budget:
+            print(
+                f"# degraded sections {degraded} but recovery budget "
+                f"({recovery_budget}s) already exhausted; skipping the "
+                f"recovery pass", file=sys.stderr,
+            )
+            degraded = []
+        if degraded and not _default_backend_alive(
+            int(os.environ.get("BENCH_RECOVERY_PROBE_TIMEOUT", "90")),
+            require_accel=True,
+        ):
+            print(
+                f"# degraded sections {degraded}: recovery probe found no "
+                f"accelerator; keeping first-pass records", file=sys.stderr,
+            )
+            degraded = []
+        if degraded:
+            print(
+                f"# accelerator recovered; re-running degraded sections: "
+                f"{degraded}", file=sys.stderr,
+            )
+            reruns: list = []
+            for n in degraded:
+                # re-check the budget per section: reruns are serial and the
+                # headline alone can hold a 3600s leash — one pre-loop check
+                # could blow hours past the budget on a re-wedged tunnel
+                if time.time() - t_start >= recovery_budget:
+                    print(
+                        f"# recovery budget ({recovery_budget}s) exhausted; "
+                        f"skipping remaining reruns", file=sys.stderr,
+                    )
+                    break
+                # first rerun probes with full retries (the recovery probe
+                # just succeeded); once a RERUN itself re-degrades, later
+                # reruns shed to one probe — same logic as the first pass
+                rerun = _run_section(n, extra_env=shed_env(*reruns))
+                reruns.append(rerun)
+                if _rerun_improves(rerun, sections[n]):
+                    sections[n] = rerun
+                    recovered.append(n)
+            headline, windowed, batch_ab = (
+                sections["headline"], sections["windowed"],
+                sections["batch_ab"],
+            )
+    if recovered:
+        # re-emit with the adopted reruns; the driver reads the LAST stdout
+        # line, so this becomes the record (the first-pass emit remains the
+        # fallback if this process dies mid-recovery)
+        _emit_record(headline, windowed, batch_ab, recovered)
+
+
+def _emit_record(headline, windowed, batch_ab, recovered):
+    """Write bench_detail.json and print the detail line + the compact
+    final JSON line for the given section records."""
+    head = headline.get("result") or {}
 
     serving = head.get("serving", {})
     torch_mpm = head.get("torch_baseline_machines_per_min") or 0
@@ -692,6 +832,10 @@ def main():
         "platform": headline.get("platform", "unknown"),
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
     }
+    if recovered:
+        # the detail record must also show which sections are recovery-pass
+        # reruns — the compact line alone can be lost to a tail capture
+        detail["recovered_sections"] = recovered
     detail_file = os.environ.get("BENCH_DETAIL_FILE", "bench_detail.json")
     try:
         with open(detail_file, "w") as fh:
@@ -742,6 +886,8 @@ def main():
         },
         "detail_file": detail_file,
     }
+    if recovered:
+        out["recovered_sections"] = recovered
     for name, section in (("headline", headline), ("windowed", windowed),
                           ("batch_ab", batch_ab)):
         if "error" in section:
