@@ -529,8 +529,13 @@ class DataLakeProvider(GordoBaseDataProvider):
         if self.sas_token:
             # parse_qsl percent-DECODES values; requests re-encodes them on
             # send, so the wire form matches the token exactly (a naive
-            # split would double-encode sig= and 403 every request)
-            params.update(parse_qsl(self.sas_token.lstrip("?")))
+            # split would double-encode sig= and 403 every request).
+            # keep_blank_values: some SAS generators emit empty-valued
+            # params (e.g. '&sdd='); dropping one mutates the signed query
+            # and 403s every request
+            params.update(
+                parse_qsl(self.sas_token.lstrip("?"), keep_blank_values=True)
+            )
         elif self.bearer_token:
             headers["Authorization"] = f"Bearer {self.bearer_token}"
         elif self.account_key:

@@ -39,7 +39,7 @@ def dp_degree(spec: Any) -> int:
 
 def prepare_dp_spec(spec: ModelSpec) -> ModelSpec:
     """Validate a data-parallel spec at build time."""
-    from gordo_tpu.models.spec import TransformerBlock
+    from gordo_tpu.models.spec import MoEBlock, TransformerBlock
     from gordo_tpu.ops.attention import spec_may_use_ring
 
     dp = dp_degree(spec)
@@ -64,7 +64,10 @@ def prepare_dp_spec(spec: ModelSpec) -> ModelSpec:
     layers = []
     changed = False
     for layer in spec.layers:
-        if isinstance(layer, TransformerBlock):
+        # MoEBlock carries the same attention_impl field and attention path
+        # as TransformerBlock — both must be pinned off the single-device
+        # flash kernel under the data mesh
+        if isinstance(layer, (TransformerBlock, MoEBlock)):
             if layer.attention_impl == "flash":
                 raise ValueError(
                     "attention='flash' cannot run under data_parallel "

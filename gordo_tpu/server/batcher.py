@@ -233,6 +233,7 @@ class CrossModelBatcher:
                 # queue behind it — predict direct this once
                 return False
             self._calibrating.add(spec)
+        won: Optional[bool] = None
         try:
             # clamped: zero users/rounds would leave the sample list empty
             # and turn a config mistake into a cryptic stand-down
@@ -323,9 +324,19 @@ class CrossModelBatcher:
             # must not be converted into a silent stand-down)
             logger.warning("batcher self-A/B failed (%s); standing down", exc)
             won = False
-        with self._lock:
-            self._spec_on[spec] = won
-            self._calibrating.discard(spec)
+        finally:
+            # ALWAYS leave the calibrating set, even on a propagating
+            # BaseException (worker shutdown mid-A/B): a leaked entry would
+            # silently pin this spec to the direct path forever with no
+            # recorded decision. Decision-record and discard happen under
+            # ONE lock acquisition — discarding first would let another
+            # thread start a duplicate A/B storm in the gap. A propagated
+            # BaseException leaves `won` None and records nothing, so the
+            # next submit re-attempts calibration.
+            with self._lock:
+                if won is not None:
+                    self._spec_on[spec] = won
+                self._calibrating.discard(spec)
         return won
 
     def _force_submit(self, spec, params, X) -> np.ndarray:

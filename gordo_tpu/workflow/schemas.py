@@ -191,6 +191,18 @@ def validate_pod_runtime(
     }
     if builder:
         allowed["remote_logging"] = False
+    # standard pod-spec keys pass through unvalidated (kept in the runtime
+    # dict; whether a template renders them is the template's choice): the
+    # reference's pydantic v1 silently IGNORED any unmodelled key, so
+    # configs carrying these deployed fine — rejecting them here would
+    # break those configs on switch-over, and they are not plausible typos
+    # of the modelled keys (the typo protection this schema exists for)
+    for passthrough in (
+        "nodeSelector", "affinity", "tolerations", "imagePullPolicy",
+        "serviceAccountName", "securityContext", "annotations", "labels",
+        "priorityClassName",
+    ):
+        allowed[passthrough] = False
     _check_keys(obj, allowed, path)
     if "image" in obj:
         _expect_str(obj["image"], f"{path}.image")

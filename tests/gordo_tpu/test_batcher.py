@@ -194,3 +194,23 @@ def test_env_auto_enables_self_ab(monkeypatch):
     b = batcher_mod.get_batcher()
     assert b is not None and not b.self_ab
     monkeypatch.setattr(batcher_mod, "_batcher", None)
+
+
+def test_calibration_interrupt_does_not_leak(models, monkeypatch):
+    """A BaseException (worker shutdown) mid-self-A/B must propagate AND
+    leave the calibrating set — a leaked entry would silently pin the spec
+    to the direct path forever with no recorded decision."""
+    import gordo_tpu.ops.train as train_mod
+
+    def boom(spec):
+        raise SystemExit(1)
+
+    monkeypatch.setattr(train_mod, "predict_fn", boom)
+    b = CrossModelBatcher(self_ab=True)
+    m = models[0]
+    X = np.random.RandomState(0).rand(10, 4).astype(np.float32)
+    with pytest.raises(SystemExit):
+        b.submit(m.spec_, m.params_, X)
+    assert m.spec_ not in b._calibrating
+    # no decision recorded: the next submit re-attempts calibration
+    assert m.spec_ not in b._spec_on

@@ -157,3 +157,23 @@ def test_dp_artifact_pickle_roundtrip():
     loaded = pickle.loads(blob)
     out = loaded.predict(X[:16])
     np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_dp_pins_moe_attention_too():
+    """MoEBlock carries the same attention_impl/attention path as
+    TransformerBlock — dp must pin auto->xla and reject flash for it as
+    well (a single-device flash kernel under a GSPMD-split batch)."""
+    from gordo_tpu.models.models import TransformerAutoEncoder
+    from gordo_tpu.models.spec import MoEBlock
+
+    with pytest.raises(ValueError, match="flash"):
+        TransformerAutoEncoder(
+            kind="moe_transformer_model", lookback_window=16,
+            attention="flash", data_parallel=4,
+        ).build_spec(4, 4)
+    spec = TransformerAutoEncoder(
+        kind="moe_transformer_model", lookback_window=16, data_parallel=4
+    ).build_spec(4, 4)
+    moe_blocks = [l for l in spec.layers if isinstance(l, MoEBlock)]
+    assert moe_blocks
+    assert all(layer.attention_impl == "xla" for layer in moe_blocks)

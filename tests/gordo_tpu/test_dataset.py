@@ -470,3 +470,19 @@ def test_adls_missing_file_raises_ioerror():
             pd.Timestamp("2019-01-02", tz="UTC"),
             [SensorTag("absent", "plant")],
         ))
+
+
+def test_adls_sas_blank_value_param_preserved():
+    """Empty-valued SAS params (some generators emit '&sdd=') must survive
+    parsing verbatim — dropping one mutates the signed query and 403s."""
+    from gordo_tpu.dataset.data_provider import DataLakeProvider
+    from gordo_tpu.dataset.sensor_tag import SensorTag
+
+    index = pd.date_range("2019-01-01", periods=4, freq="10min", tz="UTC")
+    stub = _ADLSStub({"/data/t.parquet": _parquet_blob(index, np.ones(4))})
+    provider = DataLakeProvider(
+        store_name="acct", sas_token="sv=2021&sdd=&sig=xyz", session=stub
+    )
+    got = list(provider.load_series(index[0], index[-1], [SensorTag("t", "")]))
+    assert len(got) == 1
+    assert stub.calls[0]["params"] == {"sv": "2021", "sdd": "", "sig": "xyz"}

@@ -148,3 +148,22 @@ def test_tpu_chip_resource_quantities_pass():
     validate_runtime(
         {"builder": {"resources": {"limits": {"google.com/tpu": 8}}}}
     )
+
+
+def test_standard_pod_keys_pass_through():
+    """Legit k8s pod-spec keys the schema doesn't model in depth must not
+    hard-fail config load (the reference's pydantic v1 ignored them, so
+    existing configs carry them) — while actual typos still error."""
+    validate_runtime(
+        {
+            "builder": {
+                "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x2"},
+                "tolerations": [{"key": "tpu", "operator": "Exists"}],
+                "imagePullPolicy": "Always",
+                "affinity": {"nodeAffinity": {}},
+            },
+            "server": {"serviceAccountName": "gordo-server"},
+        }
+    )
+    with pytest.raises((RuntimeConfigError, ValueError), match="unknown key"):
+        validate_runtime({"builder": {"nodeSelectr": {"a": "b"}}})
