@@ -55,6 +55,12 @@ class TransformerBlock:
     # (ring = sequence-parallel exact attention over the device mesh, for
     # lookback windows too long for one chip — parallel/ring_attention.py)
     attention_impl: str = "auto"
+    # one fused (d, 3d) QKV projection instead of three (d, d) matmuls —
+    # same math, fewer dispatches. prepare_tp_spec turns it OFF: the concat
+    # of column-sharded weights breaks the Megatron comm pattern (measured:
+    # all-gathers/all-to-alls appear). Read with getattr(default True) so
+    # specs pickled before this field existed keep working.
+    fuse_qkv: bool = True
 
 
 @dataclass(frozen=True)
@@ -78,6 +84,8 @@ class MoEBlock:
     activation: str = "relu"
     causal: bool = False
     attention_impl: str = "auto"
+    # see TransformerBlock.fuse_qkv (same attention sublayer)
+    fuse_qkv: bool = True
     # Switch load-balancing auxiliary loss weight (Fedus et al. §2.2:
     # num_experts * sum_e fraction_routed_e * mean_gate_e). Without it the
     # top-1 router is prone to expert collapse — one hot expert absorbs all

@@ -266,13 +266,25 @@ def _apply_positional_encoding(layer: PositionalEncoding, x):
     return x + pe[None, :, :]
 
 
-def _attention_sublayer(layer, p, x):
+def _attention_sublayer(layer, p, x, fuse_qkv=None):
     """Pre-LN MHA + residual, shared by TransformerBlock and MoEBlock
-    (same param keys, same dispatch)."""
+    (same param keys, same dispatch). ``fuse_qkv=None`` defers to the
+    layer's own flag (shard_map callers — PP stages, EP — hold local
+    params, where fusion is always safe)."""
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-    q = h @ p["wq"] + p["bq"]
-    k = h @ p["wk"] + p["bk"]
-    v = h @ p["wv"] + p["bv"]
+    fuse = fuse_qkv if fuse_qkv is not None else getattr(layer, "fuse_qkv", True)
+    if fuse:
+        # one fused (d, 3d) projection instead of three (d, d) matmuls —
+        # same math, fewer dispatches (params stay separate, so the
+        # artifact format is untouched). prepare_tp_spec disables this:
+        # under the Megatron column shardings the concat costs collectives.
+        w_qkv = jnp.concatenate([p["wq"], p["wk"], p["wv"]], axis=1)
+        b_qkv = jnp.concatenate([p["bq"], p["bk"], p["bv"]])
+        q, k, v = jnp.split(h @ w_qkv + b_qkv, 3, axis=-1)
+    else:
+        q = h @ p["wq"] + p["bq"]
+        k = h @ p["wk"] + p["bk"]
+        v = h @ p["wv"] + p["bv"]
     # an explicit per-layer impl pins the choice; "auto" defers to the
     # dispatcher (and its GORDO_TPU_ATTENTION_IMPL env override)
     layer_impl = getattr(layer, "attention_impl", "auto")
@@ -287,9 +299,9 @@ def _attention_sublayer(layer, p, x):
     return x + attn @ p["wo"] + p["bo"]
 
 
-def _apply_transformer_block(layer: TransformerBlock, p, x):
+def _apply_transformer_block(layer: TransformerBlock, p, x, fuse_qkv=None):
     """Pre-LN encoder block. x: (batch, time, d_model)."""
-    x = _attention_sublayer(layer, p, x)
+    x = _attention_sublayer(layer, p, x, fuse_qkv=fuse_qkv)
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = _activation(layer.activation)(h @ p["w_ff1"] + p["b_ff1"])
     return x + ff @ p["w_ff2"] + p["b_ff2"]
@@ -369,7 +381,9 @@ def moe_aux_loss(layer: MoEBlock, gates: jnp.ndarray) -> jnp.ndarray:
     return layer.num_experts * jnp.sum(f * p_mean)
 
 
-def _apply_moe_block(layer: MoEBlock, p, x, ffn_fn=None, return_aux=False):
+def _apply_moe_block(
+    layer: MoEBlock, p, x, ffn_fn=None, return_aux=False, fuse_qkv=None
+):
     """Pre-LN MoE encoder block. x: (batch, time, d_model).
 
     ``ffn_fn(layer, expert_w, flat, gates)`` overrides the routed-FFN
@@ -377,7 +391,7 @@ def _apply_moe_block(layer: MoEBlock, p, x, ffn_fn=None, return_aux=False):
     routing are identical either way. With ``return_aux`` the weighted
     Switch load-balancing loss rides along for the training penalty.
     """
-    x = _attention_sublayer(layer, p, x)
+    x = _attention_sublayer(layer, p, x, fuse_qkv=fuse_qkv)
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     b, t, d = h.shape
     flat = h.reshape(b * t, d)
@@ -475,6 +489,16 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
         else []
     )
 
+    # fusion gate computed at the point of use: a TP spec must never run
+    # the fused QKV projection over column-sharded weights, regardless of
+    # where the spec came from (prepare_tp_spec pins layer.fuse_qkv=False
+    # for canonical specs, but an artifact pickled before that field
+    # existed would default back on — this guard makes it structural)
+    tp_active = int(getattr(spec, "tensor_parallel", 0) or 0) > 1
+
+    def _fuse(layer):
+        return getattr(layer, "fuse_qkv", True) and not tp_active
+
     penalty = jnp.asarray(0.0, jnp.float32)
     for i, (layer, p) in enumerate(zip(spec.layers, params)):
         if pp_blocks and i in pp_blocks:
@@ -498,7 +522,10 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
         elif isinstance(layer, PositionalEncoding):
             out = _apply_positional_encoding(layer, out)
         elif isinstance(layer, TransformerBlock):
-            out = _seq_layer(_apply_transformer_block, layer, p, out)
+            out = _seq_layer(
+                functools.partial(_apply_transformer_block, fuse_qkv=_fuse(layer)),
+                layer, p, out,
+            )
         elif isinstance(layer, MoEBlock):
             if int(getattr(spec, "expert_parallel", 0) or 0) > 1:
                 from gordo_tpu.parallel.expert_parallel import apply_ep_moe_block
@@ -508,7 +535,10 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
                 )
             else:
                 out, aux = _seq_layer(
-                    functools.partial(_apply_moe_block, return_aux=True),
+                    functools.partial(
+                        _apply_moe_block, return_aux=True,
+                        fuse_qkv=_fuse(layer),
+                    ),
                     layer, p, out,
                 )
             penalty = penalty + aux

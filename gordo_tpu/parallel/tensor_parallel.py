@@ -84,8 +84,13 @@ def prepare_tp_spec(spec: ModelSpec) -> ModelSpec:
                 f"parallel (single-device kernel / whole-mesh shard_map); "
                 f"use attention='xla' (or 'auto') with tensor_parallel"
             )
-        if layer.attention_impl != "xla":
-            layer = replace(layer, attention_impl="xla")
+        # fuse_qkv=False: the fused (d, 3d) projection concatenates the
+        # three column-sharded weights, which breaks the Megatron layout —
+        # measured on the 8-virtual-device mesh, the concat turned the
+        # clean 2-all-reduce-per-block program into one with all-gathers,
+        # collective-permutes and all-to-alls. Three head-aligned matmuls
+        # keep the comm pattern exact.
+        layer = replace(layer, attention_impl="xla", fuse_qkv=False)
         layers.append(layer)
     return replace(spec, layers=tuple(layers))
 

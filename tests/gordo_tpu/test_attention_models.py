@@ -302,3 +302,38 @@ def test_ring_machines_take_serial_path():
     assert _plan_machine(Machine.from_config(cfg, project_name="t")) is None
     cfg["model"]["gordo_tpu.models.models.TransformerAutoEncoder"]["attention"] = "auto"
     assert _plan_machine(Machine.from_config(cfg, project_name="t")) is not None
+
+
+def test_fused_qkv_matches_unfused_and_tp_disables_it():
+    """The fused (d, 3d) QKV projection is bit-equivalent math to the three
+    separate matmuls, and prepare_tp_spec turns it off — the concat of
+    column-sharded weights would break the Megatron comm pattern."""
+    import dataclasses
+
+    spec = transformer_model(n_features=4, lookback_window=16)
+    params = nn.init_model_params(jax.random.PRNGKey(0), spec)
+    x = jnp.asarray(np.random.RandomState(0).rand(3, 16, 4), jnp.float32)
+    out_fused, _ = nn.apply_model(spec, params, x)
+
+    unfused_layers = tuple(
+        dataclasses.replace(l, fuse_qkv=False)
+        if isinstance(l, TransformerBlock) else l
+        for l in spec.layers
+    )
+    spec_unfused = dataclasses.replace(spec, layers=unfused_layers)
+    out_unfused, _ = nn.apply_model(spec_unfused, params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_fused), np.asarray(out_unfused), rtol=1e-6, atol=1e-6
+    )
+
+    # TP pins fusion off on every block (and a pre-field pickle defaults on)
+    from gordo_tpu.parallel.tensor_parallel import prepare_tp_spec
+
+    tp_spec = prepare_tp_spec(
+        dataclasses.replace(
+            transformer_model(n_features=4, lookback_window=16, num_heads=4),
+            tensor_parallel=4,
+        )
+    )
+    blocks = [l for l in tp_spec.layers if isinstance(l, TransformerBlock)]
+    assert blocks and all(not b.fuse_qkv for b in blocks)

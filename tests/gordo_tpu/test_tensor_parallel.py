@@ -10,6 +10,7 @@ serving batcher) the same way ring-attention specs do.
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
@@ -179,3 +180,48 @@ def test_tp_artifact_roundtrip(tmp_path):
     np.testing.assert_allclose(
         model.predict(_data()), out, rtol=2e-4, atol=2e-5
     )
+
+
+def test_tp_never_runs_fused_qkv_even_from_old_artifacts():
+    """The fused QKV projection concatenates column-sharded weights, which
+    costs all-gathers/all-to-alls under the Megatron layout. The guard is
+    structural (apply_model decides at the point of use), so even an
+    artifact pickled before the fuse_qkv field existed — whose blocks
+    default the flag ON — compiles to the clean comm pattern."""
+    import dataclasses
+    import pickle
+
+    import jax
+
+    from gordo_tpu.models.models import TransformerAutoEncoder
+    from gordo_tpu.models.spec import TransformerBlock
+    from gordo_tpu.ops.nn import apply_model
+
+    est = TransformerAutoEncoder(
+        kind="transformer_model", lookback_window=16, num_heads=8,
+        tensor_parallel=8, epochs=1, batch_size=16,
+    )
+    X = np.random.RandomState(0).rand(64, 8).astype(np.float32)
+    est.fit(X, X)
+    # simulate a pre-field artifact: force fuse_qkv back on, round-trip
+    est.spec_ = dataclasses.replace(
+        est.spec_,
+        layers=tuple(
+            dataclasses.replace(l, fuse_qkv=True)
+            if isinstance(l, TransformerBlock) else l
+            for l in est.spec_.layers
+        ),
+    )
+    loaded = pickle.loads(pickle.dumps(est))
+    assert loaded.predict(X).shape[0] > 0
+    # the compiled forward over the resharded params has no concat-induced
+    # resharding collectives (the fused path measurably introduces them)
+    xb = jnp.asarray(X[:16])[:, None, :].repeat(16, axis=1)
+    txt = (
+        jax.jit(lambda p, x: apply_model(loaded.spec_, p, x)[0])
+        .lower(loaded.params_, xb)
+        .compile()
+        .as_text()
+    )
+    assert "all-to-all" not in txt
+    assert "all-gather" not in txt
