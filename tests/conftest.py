@@ -125,3 +125,19 @@ def trained_model_directory(trained_model_directories, gordo_name):
 @pytest.fixture
 def metadata(trained_model_directory):
     return serializer.load_metadata(trained_model_directory)
+
+
+@pytest.fixture(scope="session")
+def X_payload(sensors):
+    """The canonical server-test input frame (20 rows x the session's
+    sensor tags) — shared by the in-process server tests and the
+    multi-process pool drive so the two suites pin one wire payload."""
+    import numpy as np
+    import pandas as pd
+
+    idx = pd.date_range("2020-01-01", periods=20, freq="10min", tz="UTC")
+    return pd.DataFrame(
+        np.random.RandomState(0).rand(20, len(sensors)),
+        columns=[t.name for t in sensors],
+        index=idx,
+    )

@@ -1,0 +1,25 @@
+"""Shared network-test helpers (used by test_dockertest, test_server_pool
+and test_distributed — keep one copy so fixes don't silently miss a twin)."""
+
+import socket
+import time
+
+
+def free_port() -> int:
+    """Ephemeral host port — concurrent runs on one host must not collide."""
+    with socket.socket() as sock:
+        sock.bind(("", 0))
+        return sock.getsockname()[1]
+
+
+def wait_for(probe, timeout: float = 30.0) -> bool:
+    """Poll ``probe()`` (exceptions count as not-ready) until truthy."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if probe():
+                return True
+        except Exception:
+            pass
+        time.sleep(0.5)
+    return False

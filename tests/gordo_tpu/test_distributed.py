@@ -11,7 +11,6 @@ numerically identical to the same machine trained single-process.
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import tempfile
@@ -117,12 +116,7 @@ print("worker", pid, "built", names, flush=True)
 """
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from _nethelpers import free_port as _free_port  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -14,9 +14,7 @@ is not available on the host.
 """
 
 import shutil
-import socket
 import subprocess
-import time
 import uuid
 
 import numpy as np
@@ -28,12 +26,8 @@ pytestmark = pytest.mark.dockertest
 _HAS_DOCKER = shutil.which("docker") is not None
 
 
-def _free_port() -> int:
-    """Ephemeral host port — concurrent dockertest runs on one host must
-    not collide (container names are uuid-unique already)."""
-    with socket.socket() as sock:
-        sock.bind(("", 0))
-        return sock.getsockname()[1]
+from _nethelpers import free_port as _free_port  # noqa: E402
+from _nethelpers import wait_for as _wait_for  # noqa: E402
 
 
 def _docker_run(image: str, name: str, ports: dict, env: dict) -> str:
@@ -56,16 +50,6 @@ def _docker_kill(name: str) -> None:
     subprocess.run(["docker", "kill", name], capture_output=True)
 
 
-def _wait_for(probe, timeout: float = 30.0) -> bool:
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        try:
-            if probe():
-                return True
-        except Exception:
-            pass
-        time.sleep(0.5)
-    return False
 
 
 @pytest.fixture(scope="module")

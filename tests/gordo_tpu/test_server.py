@@ -24,17 +24,6 @@ def client(app):
     return app.test_client()
 
 
-@pytest.fixture(scope="module")
-def X_payload(sensors):
-    idx = pd.date_range("2020-01-01", periods=20, freq="10min", tz="UTC")
-    X = pd.DataFrame(
-        np.random.RandomState(0).rand(20, 4),
-        columns=[t.name for t in sensors],
-        index=idx,
-    )
-    return X
-
-
 def test_healthcheck(client):
     resp = client.get("/healthcheck")
     assert resp.status_code == 200
