@@ -581,15 +581,11 @@ def _setup_backend(argv) -> None:
     """
     import jax
 
-    platform_tag = os.environ.get("JAX_PLATFORMS") or "default"
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR", f"/tmp/gordo_tpu_xla_cache-{platform_tag}"
-    )
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
+
+    # one dir scheme shared with serving warmup (util/xla_cache.py), so
+    # bench and server compiles land in — and re-use — the same cache
+    setup_persistent_xla_cache()
 
     # round-3 postmortem: ONE failed 180s probe surrendered the whole run to
     # CPU. Retry with backoff before giving up — a flaky tunnel usually

@@ -327,7 +327,17 @@ def batch_build(
     "(self-measuring: a startup A/B per architecture stands batching down "
     "where the fused call loses to per-request dispatch)",
 )
-def run_server_cli(host, port, workers, worker_connections, batch_predicts):
+@click.option(
+    "--warmup/--no-warmup",
+    default=False,
+    envvar="GORDO_TPU_SERVING_WARMUP",
+    help="Precompile every model's serving predict programs (per padded "
+    "row bucket) in each worker before it accepts traffic, so the first "
+    "requests don't pay XLA compiles — on TPU, tens of seconds each. "
+    "Compiles land in the persistent XLA cache and are shared across "
+    "workers and restarts.",
+)
+def run_server_cli(host, port, workers, worker_connections, batch_predicts, warmup):
     """Run the gordo-tpu model server."""
     from gordo_tpu.server import run_server
 
@@ -335,7 +345,10 @@ def run_server_cli(host, port, workers, worker_connections, batch_predicts):
     # then builds its own batcher on first use. "auto" = measured per-spec
     # self-A/B at first use (server/batcher.py), never a blind always-on
     os.environ["GORDO_TPU_SERVING_BATCH"] = "auto" if batch_predicts else "0"
-    run_server(host, port, workers, worker_connections=worker_connections)
+    run_server(
+        host, port, workers, worker_connections=worker_connections,
+        warmup=warmup,
+    )
 
 
 gordo.add_command(build)

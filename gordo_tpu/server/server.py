@@ -233,6 +233,7 @@ def run_server(
     port: int = 5555,
     workers: int = 2,
     worker_connections: int = 50,
+    warmup: bool = False,
     **kwargs,
 ):
     """
@@ -265,6 +266,31 @@ def run_server(
 
         use_multiprocess_values()
 
+    def _maybe_warmup():
+        # per process, AFTER any fork (jax/XLA state must not cross fork).
+        # On a fresh boot every worker warms itself — workers fork together
+        # and the XLA cache has no in-flight dedupe — but the persistent
+        # cache established below makes restarts (and later workers'
+        # stragglers) near-free.
+        if not warmup:
+            return
+        try:
+            collection_dir = default_config()["MODEL_COLLECTION_DIR"]
+            if not collection_dir:
+                logger.warning("warmup requested but MODEL_COLLECTION_DIR unset")
+                return
+            from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
+
+            setup_persistent_xla_cache()
+            from gordo_tpu.server.warmup import warmup_collection
+
+            warmup_collection(collection_dir)
+        except Exception:  # noqa: BLE001 — warmup must NEVER stop the
+            # server: an unreadable collection dir or malformed knob would
+            # otherwise crash every respawned worker until the fast-death
+            # throttle kills the whole pool; the lazy path still serves
+            logger.exception("serving warmup failed; serving lazily")
+
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.bind((host, port))
@@ -276,6 +302,7 @@ def run_server(
     if workers == 1:
         # single worker: serve inline, no arbiter
         app = build_app()
+        _maybe_warmup()
         make_server(host, port, app, threaded=True, fd=sock.fileno()).serve_forever()
         return
 
@@ -291,15 +318,21 @@ def run_server(
 
     worker_pids: set = set()
     spawn_times: dict = {}
+    ready_fds: dict = {}
     shutting_down = False
-    # A worker dying within FAST_DEATH_S of its spawn counts as a boot
-    # failure; MAX_FAST_DEATHS consecutive ones stop the respawn loop (the
-    # gunicorn arbiter's worker-boot-error throttle) instead of fork-bombing.
+    # A worker that dies before signalling readiness (one byte on its
+    # readiness pipe, sent just before serve_forever) OR within
+    # FAST_DEATH_S of its spawn counts as a boot failure; MAX_FAST_DEATHS
+    # consecutive ones stop the respawn loop (the gunicorn arbiter's
+    # worker-boot-error throttle) instead of fork-bombing. The pipe —
+    # not wall-clock alone — classifies deaths because warmup makes a
+    # legitimate boot take arbitrarily long: a worker OOM-killed 30s into
+    # model loading must still count as a boot failure.
     FAST_DEATH_S = 2.0
     MAX_FAST_DEATHS = 5
     fast_deaths = 0
 
-    def _serve_child() -> "None":  # never returns
+    def _serve_child(ready_w: int) -> "None":  # never returns
         # any escape path must os._exit: an exception unwinding out of the
         # forked child would execute the arbiter's inherited finally block
         # (SIGTERM-ing healthy siblings) in the child
@@ -309,9 +342,14 @@ def run_server(
             # app built per worker process: model cache and metric values are
             # process-local (metrics aggregate via the multiprocess dir)
             app = build_app()
-            make_server(
-                host, port, app, threaded=True, fd=sock.fileno()
-            ).serve_forever()
+            _maybe_warmup()
+            server = make_server(host, port, app, threaded=True, fd=sock.fileno())
+            try:
+                os.write(ready_w, b"R")
+                os.close(ready_w)
+            except OSError:
+                pass
+            server.serve_forever()
         except BaseException:
             logger.exception("worker failed to boot/serve")
             os._exit(1)
@@ -319,15 +357,38 @@ def run_server(
 
     def _spawn() -> None:
         start = _time.monotonic()
+        # the write end is held ONLY by this child (the parent closes its
+        # copy right after fork, and earlier siblings predate the pipe), so
+        # the child's death guarantees EOF — _reap's read can never block
+        ready_r, ready_w = os.pipe()
+        os.set_blocking(ready_r, False)
         pid = os.fork()
         if pid == 0:
-            _serve_child()
+            os.close(ready_r)
+            # also close inherited read ends of live siblings' readiness
+            # pipes — harmless for EOF semantics, but stale fds would
+            # otherwise accumulate in long-lived workers over respawn churn
+            for fd in ready_fds.values():
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            _serve_child(ready_w)
+        os.close(ready_w)
         # spawn time recorded before the pid becomes reapable via
         # worker_pids, so _reap never sees a missing entry
         spawn_times[pid] = start
+        ready_fds[pid] = ready_r
         worker_pids.add(pid)
 
-    def _reap(signum, frame):
+    def _reap():
+        # Called ONLY from the arbiter's poll loop (the SIGCHLD handler is
+        # a no-op waker): reap-and-respawn used to run inside the handler,
+        # and a handler interrupting a loop-side sweep mid-pid could
+        # double-count one death — and rapid consecutive deaths were
+        # OBSERVED leaving an unreaped zombie and a stalled pool when
+        # delivery landed in an unlucky window. Single-threaded sweeps are
+        # immune to both; worst-case reaction is one poll tick.
         # Only pids in worker_pids are waited on, so exit statuses of
         # unrelated subprocesses are never stolen from their owners.
         nonlocal fast_deaths
@@ -343,7 +404,15 @@ def run_server(
                 if shutting_down:
                     continue
                 lifetime = _time.monotonic() - spawn_times.pop(pid, 0.0)
-                if lifetime < FAST_DEATH_S:
+                ready_r = ready_fds.pop(pid, None)
+                became_ready = False
+                if ready_r is not None:
+                    try:
+                        became_ready = os.read(ready_r, 1) == b"R"
+                    except OSError:
+                        became_ready = False
+                    os.close(ready_r)
+                if lifetime < FAST_DEATH_S or not became_ready:
                     fast_deaths += 1
                 else:
                     fast_deaths = 0
@@ -366,19 +435,20 @@ def run_server(
         # handlers installed inside the try so a SIGTERM arriving while
         # workers are being forked still reaches the cleanup block
         signal.signal(signal.SIGTERM, _terminate)
-        # installed before forking so a worker dying at startup is reaped
-        signal.signal(signal.SIGCHLD, _reap)
+        # a no-op HANDLER (not SIG_IGN, which would auto-discard child
+        # statuses and break waitpid): keeps children reapable while all
+        # actual reaping happens in the poll loop below
+        signal.signal(signal.SIGCHLD, lambda signum, frame: None)
         for _ in range(workers):
             _spawn()
-        # catch any worker that died before its pid entered worker_pids
-        # (SIGCHLD delivered mid-loop finds an incomplete set)
-        _reap(None, None)
+        _reap()
         RETRY_S = 10.0
         last_retry = _time.monotonic()
         while True:
-            # poll-sleep instead of signal.pause(): the terminal condition
-            # can be reached by handlers that ran before pause() would
-            # block, after which no further SIGCHLD ever arrives
+            # poll-sleep arbiter (gunicorn-style): every tick sweeps with
+            # WNOHANG — SIGCHLD delivery is not a reliable queue, so the
+            # sweep, not the signal, is the source of truth
+            _reap()
             if fast_deaths >= MAX_FAST_DEATHS and not worker_pids:
                 raise RuntimeError(
                     "all workers failed at boot; see logs for the child error"

@@ -1,0 +1,134 @@
+"""
+Serving warmup: precompile every artifact's predict programs before traffic.
+
+The first predict of a (spec, padded-shape) bucket pays an XLA compile — on
+a TPU that is tens of seconds of first-request latency (the reference has no
+analog: its Keras models execute eagerly, gordo/server loads pickles lazily
+per request, server/utils.py:323-343). Serving shapes here are padded to
+power-of-two buckets (ops/train.pad_for_predict), so the program set is
+finite: warming compiles the programs for the configured row buckets
+(``GORDO_TPU_WARMUP_ROWS``, default 128 and 1024 — a request padding to a
+bucket outside that list still pays its first compile), and a persistent
+XLA cache (``JAX_COMPILATION_CACHE_DIR``, which run-server establishes
+when warmup is on) carries compiles across worker processes and restarts.
+
+``run-server --warmup`` (or ``GORDO_TPU_SERVING_WARMUP=1``) runs this in
+each worker after fork, before the worker starts accepting; models sharing
+a ModelSpec share programs (ops/train._build_predictor caches by spec), so
+fleets of same-architecture machines warm in one compile. When the
+cross-model batcher is enabled (the run-server default), the warmup
+predicts route through it like real traffic — in auto mode the first
+predict per architecture runs the batcher's measured self-A/B, so both
+the fused programs and the on/off decision are in place before the first
+request (pinned by tests).
+"""
+
+import logging
+import os
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _default_bucket_rows():
+    """Serving-time row buckets to precompile per model. 128 covers the
+    reference benchmark harness shape (100 samples x tags, padded to 128);
+    1024 brackets typical client batch sizes. A malformed
+    ``GORDO_TPU_WARMUP_ROWS`` falls back to the defaults with a warning —
+    warmup is best-effort and must not abort over a config typo."""
+    env = os.environ.get("GORDO_TPU_WARMUP_ROWS")
+    if env:
+        try:
+            rows = tuple(
+                int(part) for part in env.split(",") if part.strip()
+            )
+        except ValueError:
+            rows = ()
+        if rows and all(r > 0 for r in rows):
+            return rows
+        logger.warning(
+            "malformed GORDO_TPU_WARMUP_ROWS=%r; using defaults %s",
+            env, DEFAULT_BUCKET_ROWS,
+        )
+    return DEFAULT_BUCKET_ROWS
+
+
+DEFAULT_BUCKET_ROWS = (128, 1024)
+
+
+def _model_names(collection_dir: str) -> list:
+    names = []
+    for name in sorted(os.listdir(collection_dir)):
+        path = os.path.join(collection_dir, name)
+        if os.path.isdir(path) and os.path.exists(
+            os.path.join(path, "metadata.json")
+        ):
+            names.append(name)
+    return names
+
+
+def warmup_collection(
+    collection_dir: str,
+    bucket_rows: Optional[Iterable[int]] = None,
+    names: Optional[Iterable[str]] = None,
+) -> dict:
+    """Load each model in the collection and run one predict per row
+    bucket, compiling the serving programs traffic will hit.
+
+    Returns ``{"models": N, "programs": M, "seconds": S, "failed": [...]}``.
+    A model that fails to warm is logged and skipped — warmup must never
+    prevent the server from starting (the lazy path still works).
+    """
+    from gordo_tpu.server.utils import load_metadata, load_model
+
+    t0 = time.monotonic()
+    if bucket_rows is None:
+        bucket_rows = _default_bucket_rows()
+    names = list(names) if names is not None else _model_names(collection_dir)
+    programs = 0
+    warmed = 0
+    failed = []
+    for name in names:
+        try:
+            metadata = load_metadata(collection_dir, name)
+            tags = (
+                metadata.get("dataset", {}).get("tags")
+                or metadata.get("dataset", {}).get("tag_list")
+                or []
+            )
+            offset = (
+                metadata.get("metadata", {})
+                .get("build_metadata", {})
+                .get("model", {})
+                .get("model_offset", 0)
+            )
+            n_features = len(tags)
+            if n_features == 0:
+                raise ValueError("no tags in metadata")
+            model = load_model(collection_dir, name)
+            for bucket in bucket_rows:
+                # + offset so windowed models produce exactly `bucket`
+                # output rows — the same power-of-two program bucket real
+                # requests of that size compile
+                X = np.zeros((int(bucket) + int(offset), n_features), np.float32)
+                model.predict(X)
+                programs += 1
+            warmed += 1
+        except Exception as exc:  # noqa: BLE001 — warmup is best-effort
+            logger.warning("warmup failed for model %r: %s", name, exc)
+            failed.append(name)
+    seconds = time.monotonic() - t0
+    logger.info(
+        "serving warmup: %d model(s), %d predict program(s) in %.1fs%s",
+        warmed, programs, seconds,
+        f" ({len(failed)} failed: {failed})" if failed else "",
+    )
+    return {
+        "models": warmed,
+        "programs": programs,
+        "seconds": round(seconds, 2),
+        "failed": failed,
+    }

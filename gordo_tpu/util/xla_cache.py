@@ -1,0 +1,31 @@
+"""Persistent XLA compilation cache setup, shared by bench.py and serving
+warmup — one copy of the directory scheme so their compiles land in (and
+re-use) the same cache."""
+
+import os
+
+
+def setup_persistent_xla_cache(min_compile_secs: float = 1.0) -> str:
+    """Point jax at the platform-partitioned persistent compile cache.
+
+    Via ``jax.config``, not env: jax reads ``JAX_COMPILATION_CACHE_DIR`` at
+    import, long before callers run. Partitioned by platform tag — a
+    remote-compiled TPU artifact must never be offered to a CPU-fallback
+    process on a host with different machine features. Failures are
+    swallowed (the cache is an optimization only). Returns the dir used.
+    """
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        "/tmp/gordo_tpu_xla_cache-"
+        + (os.environ.get("JAX_PLATFORMS") or "default"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    return cache_dir

@@ -329,3 +329,114 @@ def test_prometheus_batcher_metrics(
     stats = batcher_mod._batcher.stats
     assert stats["items"] >= 5
     assert f'gordo_server_batcher_items{{project="test-proj"}} {float(stats["items"])}' in text
+
+
+def test_warmup_collection(
+    model_collection_directory, trained_model_directories, monkeypatch
+):
+    """Warmup compiles one predict program per (model, row bucket) and
+    reports what it did."""
+    from gordo_tpu.server import warmup
+
+    # an ambient GORDO_TPU_WARMUP_ROWS would change the default bucket set
+    monkeypatch.delenv("GORDO_TPU_WARMUP_ROWS", raising=False)
+    result = warmup.warmup_collection(model_collection_directory)
+    assert result["failed"] == []
+    assert result["models"] == len(trained_model_directories)
+    assert result["programs"] == result["models"] * len(warmup.DEFAULT_BUCKET_ROWS)
+
+
+def test_warmup_windowed_model_uses_offset(tmp_path):
+    """A windowed artifact warms at bucket+offset rows so the compiled
+    program bucket matches real requests of that size."""
+    import numpy as np
+
+    from gordo_tpu import serializer
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.server import warmup
+
+    machine = Machine.from_config(
+        {
+            "name": "warm-lstm",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["w-0", "w-1"],
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+            },
+            "model": {
+                "gordo_tpu.models.models.LSTMAutoEncoder": {
+                    "kind": "lstm_symmetric",
+                    "lookback_window": 4,
+                    "epochs": 1,
+                }
+            },
+        },
+        project_name="warm",
+    )
+    model, machine_out = ModelBuilder(machine).build()
+    mdir = tmp_path / "warm-lstm"
+    mdir.mkdir()
+    serializer.dump(model, str(mdir), metadata=machine_out.to_dict())
+
+    result = warmup.warmup_collection(str(tmp_path), bucket_rows=(8,))
+    assert result == {
+        "models": 1, "programs": 1, "seconds": result["seconds"], "failed": [],
+    }
+    # the warmed bucket serves a real 8-output-row request without error
+    offset = machine_out.metadata.build_metadata.model.model_offset
+    assert offset == 3  # lookback 4, lookahead 0
+    X = np.random.RandomState(0).rand(8 + offset, 2)
+    assert len(model.predict(X)) == 8
+
+
+def test_warmup_survives_broken_model(tmp_path):
+    """A corrupt artifact is reported, not raised — warmup must never stop
+    the server from starting."""
+    from gordo_tpu.server import warmup
+
+    bad = tmp_path / "broken"
+    bad.mkdir()
+    (bad / "metadata.json").write_text("{}")
+    result = warmup.warmup_collection(str(tmp_path))
+    assert result["models"] == 0
+    assert result["failed"] == ["broken"]
+
+
+def test_warmup_triggers_batcher_calibration(
+    model_collection_directory, trained_model_directories, monkeypatch
+):
+    """In a worker with the batcher in auto mode (the run-server default),
+    warmup's predicts route through the batcher like real traffic: the
+    per-architecture self-A/B runs DURING warmup, so both the fused
+    programs and the on/off decision are in place before the first
+    request."""
+    from gordo_tpu.server import batcher as batcher_mod
+    from gordo_tpu.server import warmup
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "auto")
+    monkeypatch.setenv("GORDO_TPU_BATCH_AB_USERS", "2")
+    monkeypatch.setenv("GORDO_TPU_BATCH_AB_ROUNDS", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+
+    result = warmup.warmup_collection(model_collection_directory)
+    assert result["failed"] == []
+    b = batcher_mod.peek_batcher()
+    assert b is not None
+    on, off = b.decision_counts()
+    assert on + off >= 1  # calibration ran and recorded a decision
+
+
+def test_warmup_rows_env_parsing(monkeypatch):
+    """A malformed GORDO_TPU_WARMUP_ROWS falls back to the defaults with a
+    warning instead of aborting warmup (best-effort contract)."""
+    from gordo_tpu.server import warmup
+
+    monkeypatch.setenv("GORDO_TPU_WARMUP_ROWS", "256")
+    assert warmup._default_bucket_rows() == (256,)
+    monkeypatch.setenv("GORDO_TPU_WARMUP_ROWS", "64,512")
+    assert warmup._default_bucket_rows() == (64, 512)
+    for bad in ("128;1024", "128, abc", " , ", "0", "-5"):
+        monkeypatch.setenv("GORDO_TPU_WARMUP_ROWS", bad)
+        assert warmup._default_bucket_rows() == warmup.DEFAULT_BUCKET_ROWS

@@ -26,13 +26,14 @@ from _nethelpers import wait_for as _wait_for
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _SERVER_SCRIPT = """
-import os, sys
+import logging, os, sys
+logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 sys.path.insert(0, {repo!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
 from gordo_tpu.server.server import run_server
-run_server(host="127.0.0.1", port={port}, workers=3)
+run_server(host="127.0.0.1", port={port}, workers={workers}, warmup=True)
 """
 
 
@@ -80,7 +81,8 @@ def server_pool(model_collection_directory, trained_model_directories, tmp_path)
         # new session so teardown can killpg the WHOLE pool — SIGKILLing
         # only the arbiter would orphan three live worker processes
         proc = subprocess.Popen(
-            [sys.executable, "-c", _SERVER_SCRIPT.format(repo=REPO, port=port)],
+            [sys.executable, "-c",
+             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=3)],
             env=env,
             stdout=subprocess.DEVNULL,
             stderr=errfh,
@@ -128,7 +130,7 @@ def server_pool(model_collection_directory, trained_model_directories, tmp_path)
             f"server never came up: {last_err}; stderr: "
             f"{errlog.read_text()[-2000:]}"
         )
-    yield proc, base
+    yield proc, base, errlog
     _teardown()
 
 
@@ -139,10 +141,14 @@ def test_pool_serves_and_survives_worker_kill(
     # in-process server tests so both suites pin one payload
     from gordo_tpu.server.utils import dataframe_to_dict
 
-    proc, base = server_pool
+    proc, base, errlog = server_pool
     url = f"{base}/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction"
     frame = dataframe_to_dict(X_payload)
     payload = {"X": frame, "y": frame}
+
+    # the pool booted with warmup: each worker precompiled its serving
+    # programs before accepting (run-server --warmup end-to-end)
+    assert "serving warmup:" in errlog.read_text()
 
     status, body = _post_json(url, payload)
     assert status == 200
@@ -170,3 +176,56 @@ def test_pool_serves_and_survives_worker_kill(
         ) == 3,
         timeout=60,
     ), f"pool never respawned to 3 workers: {_worker_pids(proc.pid)}"
+
+
+def test_boot_failure_during_slow_warmup_trips_throttle(tmp_path):
+    """A worker that dies DURING warmup — after more than the fast-death
+    wall-clock threshold — must still count as a boot failure (readiness
+    pipe, not just wall-clock): before the readiness signal existed, slow
+    boot deaths reset the throttle and the arbiter crash-looped forever."""
+    # a collection whose model "artifact" kills the process ~2.5s into
+    # unpickling — an OOM-kill/abort stand-in the worker cannot catch
+    mdir = tmp_path / "boom"
+    mdir.mkdir()
+    (mdir / "metadata.json").write_text(
+        json.dumps({"dataset": {"tags": ["t-0", "t-1"]},
+                    "metadata": {"build_metadata": {"model": {"model_offset": 0}}}})
+    )
+    # hand-written pickle opcodes: GLOBAL exec, TUPLE1 of the source, REDUCE
+    payload = (
+        b"c__builtin__\nexec\n"
+        b"(Vimport time,os; time.sleep(2.5); os._exit(7)\ntR."
+    )
+    (mdir / "model.pkl").write_bytes(payload)
+
+    port = _free_port()
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "MODEL_COLLECTION_DIR": str(tmp_path),
+        "PROJECT": "gordo-test",
+    }
+    errlog = tmp_path / "stderr.log"
+    with open(errlog, "w") as errfh:
+        # workers=2 engages the prefork arbiter (workers=1 serves
+        # inline); still only ~6 boot-death cycles to the throttle
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=2)],
+            env=env, stdout=subprocess.DEVNULL, stderr=errfh,
+            start_new_session=True,
+        )
+    try:
+        # ~6 boot-death cycles, each paying a fresh jax import (~20s on a
+        # loaded 1-core host) before the ~2.5s crash; without the
+        # readiness classification this NEVER exits (each death looks
+        # like a runtime death and resets the throttle)
+        rc = proc.wait(timeout=420)
+        assert rc != 0
+        assert "boot" in errlog.read_text()
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
