@@ -156,6 +156,11 @@ def build(
         # Compile the native data-layer kernels now (cache-hit after the
         # first pod) instead of stalling mid-build on first use.
         native.prebuild(block=True)
+        # XLA compiles persist across pod restarts/retries the same way
+        # (shared dir scheme with bench and serving warmup)
+        from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
+
+        setup_persistent_xla_cache()
         if model_parameter and isinstance(machine_config["model"], str):
             parameters = dict(model_parameter)
             machine_config["model"] = expand_model(
@@ -273,6 +278,9 @@ def batch_build(
 
     distributed.initialize(coordinator_address, num_processes, process_id)
     native.prebuild(block=True)
+    from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
+
+    setup_persistent_xla_cache()
     with open(config_file) as f:
         config = yaml.safe_load(f)
     norm = NormalizedConfig(config, project_name=project_name)

@@ -102,16 +102,17 @@ class Client:
             if _resolved and targets
             else self._resolve_targets(targets, revision)
         )
-        out = {}
-        for name in names:
+
+        def fetch(name):
             resp = self.session.get(
                 f"{self.base_url}/{name}/metadata",
                 params=self._params(revision),
             )
-            out[name] = _handle_response(resp, f"metadata for {name}").get(
+            return _handle_response(resp, f"metadata for {name}").get(
                 "metadata", {}
             )
-        return out
+
+        return self._fan_out(fetch, names)
 
     def download_model(
         self,
@@ -120,16 +121,41 @@ class Client:
     ) -> Dict[str, Any]:
         """Download and deserialize models, keyed by machine name."""
         names = self._resolve_targets(targets, revision)
-        out = {}
-        for name in names:
+
+        def fetch(name):
             resp = self.session.get(
                 f"{self.base_url}/{name}/download-model",
                 params=self._params(revision),
             )
-            out[name] = serializer.loads(
+            return serializer.loads(
                 _handle_response(resp, f"model for {name}")
             )
-        return out
+
+        return self._fan_out(fetch, names)
+
+    def _fan_out(self, fetch, names: List[str]) -> Dict[str, Any]:
+        """Run one GET per machine over the client's thread pool — at
+        fleet scale (1000s of machines), a serial loop would spend minutes
+        in back-to-back round trips before predict's parallel phase even
+        starts. Order-preserving; the first failure cancels the unstarted
+        remainder and propagates promptly (pool.map would drain every
+        queued doomed request — each with retry backoff — before raising).
+        requests.Session is thread-safe for concurrent gets."""
+        if len(names) <= 1:
+            return {name: fetch(name) for name in names}
+        results: Dict[str, Any] = {}
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(self.parallelism, len(names))
+        ) as pool:
+            futures = {pool.submit(fetch, name): name for name in names}
+            try:
+                for future in concurrent.futures.as_completed(futures):
+                    results[futures[future]] = future.result()
+            except BaseException:
+                for future in futures:
+                    future.cancel()
+                raise
+        return {name: results[name] for name in names}
 
     def _resolve_targets(
         self, targets: Optional[List[str]], revision: Optional[str]
@@ -212,9 +238,11 @@ class Client:
                 )
                 frames.append(frame)
                 if self.prediction_forwarder is not None:
-                    self.prediction_forwarder(
-                        predictions=frame, machine=name, metadata=metadata
-                    )
+                    # positional call: the declared type is a positional
+                    # Callable[[DataFrame, Any, dict], None] — keyword
+                    # names would break any forwarder whose parameters
+                    # aren't spelled exactly predictions/machine/metadata
+                    self.prediction_forwarder(frame, name, metadata)
             except Exception as exc:
                 errors.append(f"batch@{batch_start}: {exc}")
         predictions = (

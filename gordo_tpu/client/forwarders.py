@@ -106,13 +106,24 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
         self.batch_lines = batch_lines
         self._session = session
         self._prepared = False
+        # one forwarder is shared by Client.predict's thread-pool fan-out:
+        # without the lock, two threads could both enter _prepare and a
+        # second DROP DATABASE (recreate=True) would silently delete
+        # predictions the first thread already forwarded
+        import threading
+
+        # RLock: _prepare holds it while its first session.post touches
+        # the lazy `session` property, which re-acquires on first create
+        self._prepare_lock = threading.RLock()
 
     @property
     def session(self):
         if self._session is None:
-            import requests
+            with self._prepare_lock:
+                if self._session is None:
+                    import requests
 
-            self._session = requests.Session()
+                    self._session = requests.Session()
         return self._session
 
     def _headers(self) -> dict:
@@ -121,24 +132,25 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
         )
 
     def _prepare(self):
-        if self._prepared:
-            return
-        statements = (
-            [f'DROP DATABASE "{self.database}"'] if self.recreate else []
-        ) + [f'CREATE DATABASE "{self.database}"']
-        for q in statements:
-            resp = self.session.post(
-                f"{self.base_url}/query",
-                params={"q": q},
-                headers=self._headers(),
-            )
-            status = getattr(resp, "status_code", 200)
-            if status >= 300:
-                raise IOError(
-                    f"InfluxDB statement {q!r} failed ({status}): "
-                    f"{getattr(resp, 'text', '')[:300]}"
+        with self._prepare_lock:
+            if self._prepared:
+                return
+            statements = (
+                [f'DROP DATABASE "{self.database}"'] if self.recreate else []
+            ) + [f'CREATE DATABASE "{self.database}"']
+            for q in statements:
+                resp = self.session.post(
+                    f"{self.base_url}/query",
+                    params={"q": q},
+                    headers=self._headers(),
                 )
-        self._prepared = True
+                status = getattr(resp, "status_code", 200)
+                if status >= 300:
+                    raise IOError(
+                        f"InfluxDB statement {q!r} failed ({status}): "
+                        f"{getattr(resp, 'text', '')[:300]}"
+                    )
+            self._prepared = True
 
     def _write(self, lines) -> None:
         resp = self.session.post(

@@ -239,10 +239,22 @@ class TimeSeriesDataset(GordoBaseDataset):
                 frames[tag.name] = resampled
         df = pd.DataFrame(frames)
         if self.interpolation_method == "linear_interpolation":
-            limit = max(
-                int(pd.Timedelta(self.interpolation_limit) / pd.Timedelta(self.resolution)),
-                1,
-            )
+            try:
+                res_td = pd.Timedelta(self.resolution)
+            except ValueError:
+                # calendar-based resolution ('MS', '1M', ...): resample
+                # handles it fine above, but it has no fixed Timedelta —
+                # use the joined frame's actual median bucket spacing
+                diffs = df.index.to_series().diff().dropna()
+                res_td = diffs.median() if len(diffs) else pd.Timedelta(0)
+            if res_td > pd.Timedelta(0):
+                limit = max(
+                    int(pd.Timedelta(self.interpolation_limit) / res_td), 1
+                )
+            else:
+                # indeterminate spacing (<=1 bucket): the most conservative
+                # limit — fill single-bucket gaps only
+                limit = 1
             df = df.interpolate(method="linear", limit=limit)
         df = df.dropna()
         if self.row_filter:

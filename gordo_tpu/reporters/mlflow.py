@@ -57,7 +57,10 @@ def extract_metrics_and_params(
     if isinstance(cv.get("cv_duration_sec"), (int, float)):
         params.append(("cv_duration_sec", str(cv["cv_duration_sec"])))
 
-    history = model_meta.get("history", {}) or {}
+    # fit history lives under build_metadata.model.model_meta (the
+    # estimator's own get_metadata dict, builder/build_model.py), not
+    # directly under .model
+    history = (model_meta.get("model_meta", {}) or {}).get("history", {}) or {}
     for key, values in history.items():
         if isinstance(values, list):
             for epoch, value in enumerate(values):

@@ -55,22 +55,57 @@ def load(source_dir: Union[os.PathLike, str]) -> Any:
         return pickle.load(f)
 
 
+def _atomic_write(final: str, write_fn, mode: str) -> None:
+    """temp + rename with a UNIQUE temp name: two concurrent writers (a
+    retried pod overlapping a live one, dumping the same machine) must not
+    share a tmp path — a fixed name would let the rename promote the other
+    writer's partial bytes. The temp is cleaned up on failure."""
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(final), prefix=os.path.basename(final) + ".tmp-"
+    )
+    # mkstemp creates 0600 and os.replace keeps that mode — restore the
+    # umask-derived permissions a plain open() would have given, or a
+    # server running as a different user can no longer read the artifact
+    umask = os.umask(0)
+    os.umask(umask)
+    os.fchmod(fd, 0o666 & ~umask)
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def dump_metadata(dest_dir: Union[os.PathLike, str], metadata: dict) -> None:
     """Write ``metadata.json`` atomically (temp + rename): an artifact whose
     registry entry already exists must never be observable half-written —
     a crashed fleet build resumes by loading exactly these files."""
     os.makedirs(dest_dir, exist_ok=True)
-    final = os.path.join(dest_dir, "metadata.json")
-    tmp = final + ".tmp"
-    with open(tmp, "w") as f:
-        simplejson.dump(metadata, f, default=str)
-    os.replace(tmp, final)
+    _atomic_write(
+        os.path.join(dest_dir, "metadata.json"),
+        lambda f: simplejson.dump(metadata, f, default=str),
+        "w",
+    )
 
 
 def dump(obj: object, dest_dir: Union[os.PathLike, str], metadata: dict = None):
-    """Serialize ``obj`` (and optional metadata) into ``dest_dir``."""
+    """Serialize ``obj`` (and optional metadata) into ``dest_dir``.
+
+    The pickle is written atomically (temp + rename) like the metadata: a
+    crash mid-write must never leave a truncated ``model.pkl`` at a path a
+    registry entry or server revision already points to."""
     os.makedirs(dest_dir, exist_ok=True)
-    with open(os.path.join(dest_dir, "model.pkl"), "wb") as m:
-        pickle.dump(obj, m)
+    _atomic_write(
+        os.path.join(dest_dir, "model.pkl"),
+        lambda f: pickle.dump(obj, f),
+        "wb",
+    )
     if metadata is not None:
         dump_metadata(dest_dir, metadata)

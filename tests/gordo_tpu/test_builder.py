@@ -145,3 +145,29 @@ def test_seed_reproducibility():
     model2, _ = ModelBuilder(m2).build()
     X = np.random.RandomState(0).rand(20, 3)
     assert np.allclose(model1.predict(X), model2.predict(X))
+
+
+def test_cache_hit_does_not_resave_onto_cached_artifact(tmp_path):
+    """A cache-hit build whose destination IS the cached path must not
+    rewrite the artifact: re-pickling in place risks corrupting a
+    known-good entry and bakes the load-time from_cache marker into it."""
+    machine = Machine.from_config(machine_config(), project_name="test-project")
+    out = tmp_path / "out"
+    reg = tmp_path / "reg"
+    ModelBuilder(machine).build(output_dir=str(out), model_register_dir=str(reg))
+    blob = (out / "model.pkl").read_bytes()
+    mtime = (out / "model.pkl").stat().st_mtime_ns
+
+    model, machine_out = ModelBuilder(machine).build(
+        output_dir=str(out), model_register_dir=str(reg)
+    )
+    assert machine_out.metadata.user_defined.get("build-metadata", {}).get(
+        "from_cache"
+    )
+    assert (out / "model.pkl").read_bytes() == blob
+    assert (out / "model.pkl").stat().st_mtime_ns == mtime
+
+    # a DIFFERENT destination still receives a copy and takes over the key
+    out2 = tmp_path / "out2"
+    ModelBuilder(machine).build(output_dir=str(out2), model_register_dir=str(reg))
+    assert (out2 / "model.pkl").exists()

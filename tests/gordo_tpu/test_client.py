@@ -219,3 +219,51 @@ def test_influx_forwarder_writes_line_protocol():
         f"total-anomaly-scaled,machine=machine\\ a value=0.1 {idx[0].value}"
     )
     assert "tag\\ one=1.0" in unscaled[0]
+
+
+def test_influx_forwarder_lazy_session_no_deadlock(monkeypatch):
+    """The production path constructs the forwarder WITHOUT a session
+    (client/cli.py): the first forward() creates one lazily while the
+    prepare lock is held — this must not self-deadlock (RLock), and
+    concurrent forwards must run DROP/CREATE exactly once."""
+    import threading
+
+    import pandas as pd
+    import requests
+
+    from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+
+    posts = []
+
+    class StubResp:
+        status_code = 204
+        text = ""
+
+    class StubSession:
+        def post(self, url, params=None, data=None, headers=None):
+            posts.append((url, params))
+            return StubResp()
+
+    monkeypatch.setattr(requests, "Session", StubSession)
+    fwd = ForwardPredictionsIntoInflux(
+        destination_influx_uri="influx.example:8086/proj-db",
+        destination_influx_recreate=True,
+    )
+    idx = pd.date_range("2020-01-01", periods=2, freq="10min", tz="UTC")
+    frame = pd.DataFrame({("prediction", "t0"): [0.1, 0.2]}, index=idx)
+
+    done = []
+
+    def run():
+        fwd(frame, "m", {})
+        done.append(1)
+
+    threads = [threading.Thread(target=run) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(done) == 4, "forward() deadlocked or failed"
+    drops = [p for p in posts if p[1] and "DROP" in str(p[1].get("q", ""))]
+    creates = [p for p in posts if p[1] and "CREATE" in str(p[1].get("q", ""))]
+    assert len(drops) == 1 and len(creates) == 1

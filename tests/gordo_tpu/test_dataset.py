@@ -486,3 +486,22 @@ def test_adls_sas_blank_value_param_preserved():
     got = list(provider.load_series(index[0], index[-1], [SensorTag("t", "")]))
     assert len(got) == 1
     assert stub.calls[0]["params"] == {"sv": "2021", "sdd": "", "sig": "xyz"}
+
+
+def test_calendar_resolution_builds():
+    """Calendar-based resample frequencies ('MS') have no fixed Timedelta;
+    the interpolation-limit math must not crash on them (it uses the
+    joined frame's actual bucket spacing instead)."""
+    from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+    ds = TimeSeriesDataset(
+        train_start_date="2019-01-01T00:00:00+00:00",
+        train_end_date="2019-07-01T00:00:00+00:00",
+        tags=["cal-0", "cal-1"],
+        data_provider={"type": "RandomDataProvider"},
+        resolution="MS",
+        n_samples_threshold=0,
+    )
+    X, y = ds.get_data()
+    assert len(X) >= 3  # monthly buckets over six months
+    assert list(X.columns) == ["cal-0", "cal-1"]

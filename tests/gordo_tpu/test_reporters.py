@@ -136,7 +136,14 @@ def _machine_dict_with_scores(n_metrics=2, n_epochs=3):
                         "scores": scores,
                         "cv_duration_sec": 12.5,
                     },
-                    "history": {"loss": [float(i) for i in range(n_epochs)]},
+                    # the REAL builder shape: the estimator's get_metadata
+                    # dict (with its history) nests under model_meta
+                    # (machine/metadata.py ModelBuildMetadata.model_meta)
+                    "model_meta": {
+                        "history": {
+                            "loss": [float(i) for i in range(n_epochs)]
+                        }
+                    },
                     "model_training_duration_sec": 3.2,
                 }
             }
@@ -170,3 +177,34 @@ def test_mlflow_reporter_missing_dependency(machine):
     reporter = MlFlowReporter()
     with pytest.raises(MlFlowReporterException):
         reporter.report(machine)
+
+
+def test_extract_history_from_real_build():
+    """Pin the extract against a REAL builder-produced machine dict — a
+    hand-built fixture once drifted from the builder's actual shape and
+    silently dropped every history metric."""
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.machine import Machine
+
+    machine = Machine.from_config(
+        {
+            "name": "mlflow-hist",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["h-0", "h-1"],
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+            },
+            "model": {
+                "gordo_tpu.models.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 2,
+                }
+            },
+        },
+        project_name="mlflow-test",
+    )
+    _, machine_out = ModelBuilder(machine).build()
+    metrics, _ = extract_metrics_and_params(machine_out.to_dict())
+    keys = {k for k, _ in metrics}
+    assert any(k.startswith("history-loss-epoch-") for k in keys), keys
