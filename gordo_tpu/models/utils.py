@@ -57,8 +57,6 @@ def make_base_dataframe(
         else pd.RangeIndex(len(model_output))
     )
 
-    start_col, end_col = timestamp_columns(index, frequency)
-
     # assemble once: time columns + a single numeric block, no joins
     tuples = [("start", ""), ("end", "")]
     for name, values in (("model-input", model_input), ("model-output", model_output)):

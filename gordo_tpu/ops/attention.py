@@ -70,29 +70,35 @@ import functools
 
 @functools.lru_cache(maxsize=8)
 def _ring_fn(n_devices: int, causal: bool):
-    """Jitted ring attention over all local devices on a cached 'seq' mesh."""
+    """Jitted ring attention over all LOCAL devices on a cached 'seq' mesh.
+
+    Local, not global, like every other per-model axis (parallel/mesh.py
+    axis_mesh): in a multi-process fleet a ring-spec machine is owned by
+    one process on the serial-fallback path, and a shard_map over other
+    hosts' non-addressable chips would fail at runtime."""
     from jax.sharding import Mesh
 
     from gordo_tpu.parallel.ring_attention import make_ring_attention
 
-    mesh = Mesh(jax.devices()[:n_devices], ("seq",))
+    mesh = Mesh(jax.local_devices()[:n_devices], ("seq",))
     return make_ring_attention(mesh, seq_axis="seq", causal=causal)
 
 
 def _ring_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     """Whether ring attention can run: self-attention, >1 device, divisible T."""
-    n = len(jax.devices())
+    n = len(jax.local_devices())
     t = q.shape[-2]
     return n > 1 and k.shape[-2] == t and t % n == 0
 
 
 def ring_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
     """
-    Sequence-parallel exact attention: the time axis is sharded over ALL
-    devices and K/V blocks circulate the ring (parallel/ring_attention.py).
-    q, k, v: (..., T, Dh). T must divide by the device count.
+    Sequence-parallel exact attention: the time axis is sharded over all
+    LOCAL devices and K/V blocks circulate the ring
+    (parallel/ring_attention.py). q, k, v: (..., T, Dh). T must divide by
+    the local device count.
     """
-    n = len(jax.devices())
+    n = len(jax.local_devices())
     t, dh = q.shape[-2], q.shape[-1]
     if n == 1:
         # a 1-device ring is plain attention; lets ring-configured models

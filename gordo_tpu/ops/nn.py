@@ -462,13 +462,28 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
         out = out.astype(compute_dtype)
     if compute_dtype != jnp.float32:
         # params stay float32 at rest (optimizer state, serialization);
-        # cast per forward so matmuls run at the compute dtype
-        params = jax.tree_util.tree_map(
-            lambda a: a.astype(compute_dtype)
-            if jnp.issubdtype(a.dtype, jnp.floating)
-            else a,
-            params,
-        )
+        # cast per forward so matmuls run at the compute dtype. The MoE
+        # router weights are EXEMPT: routing is a decision, not an
+        # activation — quantizing the router matrix to bf16 can flip
+        # argmax top-1 assignments relative to the float32 model, which
+        # the router's own f32 compute (`_apply_moe_block`) cannot undo
+        def _cast(a):
+            return (
+                a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a
+            )
+
+        params = [
+            {
+                k: (v if k == "router" and isinstance(layer, MoEBlock)
+                    else jax.tree_util.tree_map(_cast, v))
+                for k, v in p.items()
+            }
+            if isinstance(p, dict)
+            else jax.tree_util.tree_map(_cast, p)
+            for layer, p in zip(spec.layers, params)
+        ]
     # remat: recompute sequence-layer activations on the backward pass
     # instead of storing them — O(layers) fewer (B, T, D) live buffers, the
     # HBM-for-FLOPs trade for long lookback windows. Dense/PE/Pool layers
@@ -530,9 +545,14 @@ def apply_model(spec: ModelSpec, params: Params, x: jnp.ndarray):
             if int(getattr(spec, "expert_parallel", 0) or 0) > 1:
                 from gordo_tpu.parallel.expert_parallel import apply_ep_moe_block
 
-                out, aux = apply_ep_moe_block(
-                    spec, layer, p, out, return_aux=True
+                ep_fn = functools.partial(
+                    apply_ep_moe_block, spec, layer, return_aux=True
                 )
+                if remat:
+                    # same remat policy as every other sequence layer —
+                    # EP must not silently keep its activations live
+                    ep_fn = jax.checkpoint(ep_fn)
+                out, aux = ep_fn(p, out)
             else:
                 out, aux = _seq_layer(
                     functools.partial(

@@ -65,6 +65,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
+    if causal:
+        # key blocks strictly past the diagonal are fully masked — bound
+        # the loop at the last block that can contain k_pos <= max(q_pos)
+        # instead of burning MXU cycles on provably-zero work
+        n_kb = jnp.minimum(n_kb, ((qi + 1) * block_q + block_k - 1) // block_k)
     m_fin, l_fin, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
     lse_ref[0] = (m_fin + jnp.log(jnp.maximum(l_fin, 1e-30)))[:, 0]
@@ -98,6 +103,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         return dq + (ds @ k_blk) * scale
 
     dq0 = jnp.zeros((block_q, dh), jnp.float32)
+    if causal:
+        # same diagonal bound as the forward: masked blocks have p == 0
+        n_kb = jnp.minimum(n_kb, ((qi + 1) * block_q + block_k - 1) // block_k)
     dq_ref[0] = jax.lax.fori_loop(0, n_kb, body, dq0).astype(dq_ref.dtype)
 
 
@@ -134,7 +142,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((block_k, dh), jnp.float32)
     dv0 = jnp.zeros((block_k, dh), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, n_qb, body, (dk0, dv0))
+    # for causal, query blocks strictly BEFORE this key block see none of
+    # it (q_pos < k_pos everywhere): start the loop at the diagonal
+    start = (ki * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

@@ -18,6 +18,7 @@ a single device call returning a scalar loss.
 """
 
 import functools
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -28,6 +29,8 @@ import optax
 
 from gordo_tpu.models.spec import ModelSpec, OptimizerSpec
 from .nn import apply_model
+
+logger = logging.getLogger(__name__)
 
 
 # --------------------------------------------------------------- optimizers
@@ -424,6 +427,16 @@ def fit_arrays(
     history: Dict[str, List[float]] = {"loss": []}
     if X_val is not None:
         history["val_loss"] = []
+        if n_train_samples(spec, len(X_val)) <= 0:
+            # a windowed model whose holdout is shorter than one lookback
+            # window records NO val_loss — and EarlyStopping's fallback
+            # would then silently monitor the TRAINING loss. Say so.
+            logger.warning(
+                "validation_split holdout (%d rows) yields no full "
+                "lookback-%d window: val_loss will not be recorded and "
+                "callbacks monitoring it fall back to training loss",
+                len(X_val), spec.lookback_window,
+            )
 
     for cb in callbacks:
         if hasattr(cb, "on_train_begin"):

@@ -152,6 +152,9 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
         model_output=output,
         target_tag_list=mc.target_tags,
         index=X.index,
+        # the model's resolution: without it every 'end' timestamp would be
+        # null (the anomaly route already passes it)
+        frequency=mc.frequency,
     )
     if request.args.get("format") == "parquet":
         return Response(

@@ -246,3 +246,54 @@ def test_moe_without_ep_batches_across_models():
     for got, want in zip(results, direct):
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
     assert b.stats["largest_batch"] == 2
+
+
+def test_bf16_compute_keeps_router_decisions_f32():
+    """compute_dtype=bfloat16 casts activations/matmuls — but NOT the MoE
+    router weights: routing is a decision, and quantizing the router can
+    flip top-1 assignments relative to the float32 model."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gordo_tpu.models.factories.transformer import moe_transformer_model
+    from gordo_tpu.ops.nn import apply_model, init_model_params
+    from gordo_tpu.models.spec import MoEBlock
+
+    spec = moe_transformer_model(
+        n_features=4, lookback_window=8, d_model=16, num_heads=2,
+        num_experts=4, expert_dim=16, num_blocks=1,
+    )
+    params = init_model_params(jax.random.PRNGKey(0), spec)
+    # craft a router whose top-2 logit columns differ by LESS than bf16
+    # resolution near 1.0 (~0.008): a bf16-cast router would tie them
+    moe_i = next(
+        i for i, l in enumerate(spec.layers) if isinstance(l, MoEBlock)
+    )
+    params = list(params)
+    p = dict(params[moe_i])
+    router = np.zeros(p["router"].shape, np.float32)
+    router[:, 0] = 1.0
+    router[:, 1] = 1.0001  # f32 argmax -> expert 1; bf16 would tie -> 0
+    p["router"] = jnp.asarray(router)
+    # make the two experts produce wildly different outputs
+    w1 = np.asarray(p["w1"]).copy()
+    w1[0] = 0.0
+    w1[1] = 100.0
+    p["w1"] = jnp.asarray(w1)
+    params[moe_i] = p
+
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 4), jnp.float32)
+    out_f32, _ = apply_model(spec, params, x)
+
+    spec_bf16 = dataclasses.replace(spec, compute_dtype="bfloat16")
+    out_bf16, _ = apply_model(spec_bf16, params, x)
+    # same routing => outputs agree to bf16 activation noise; a routing
+    # flip to expert 0 (w1=0) would change outputs by orders of magnitude
+    ratio = float(
+        jnp.linalg.norm(out_bf16.astype(jnp.float32) - out_f32)
+        / jnp.linalg.norm(out_f32)
+    )
+    assert ratio < 0.1, f"routing diverged under bf16 compute (ratio {ratio})"
