@@ -111,6 +111,24 @@ def _maybe_inject_fault():
         raise exc(f"fault injected via {FAULT_INJECTION_ENV}={fault}")
 
 
+def _reporter_options(f):
+    """The exceptions-reporter CLI surface, shared by build and batch-build
+    (one copy — the two commands' options must not drift)."""
+    f = click.option(
+        "--exceptions-report-level",
+        type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
+        default=ReportLevel.MESSAGE.name,
+        envvar="EXCEPTIONS_REPORT_LEVEL",
+        help="Detail level for exception reporting",
+    )(f)
+    f = click.option(
+        "--exceptions-reporter-file",
+        envvar="EXCEPTIONS_REPORTER_FILE",
+        help="JSON output file for exception information",
+    )(f)
+    return f
+
+
 @click.command()
 @click.argument("machine-config", envvar="MACHINE", type=yaml.safe_load)
 @click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
@@ -130,18 +148,7 @@ def _maybe_inject_fault():
     default=(),
     help="Key,value pair for model config jinja variables; repeatable.",
 )
-@click.option(
-    "--exceptions-reporter-file",
-    envvar="EXCEPTIONS_REPORTER_FILE",
-    help="JSON output file for exception information",
-)
-@click.option(
-    "--exceptions-report-level",
-    type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
-    default=ReportLevel.MESSAGE.name,
-    envvar="EXCEPTIONS_REPORT_LEVEL",
-    help="Detail level for exception reporting",
-)
+@_reporter_options
 def build(
     machine_config: dict,
     output_dir: str,
@@ -161,10 +168,12 @@ def build(
         from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
 
         setup_persistent_xla_cache()
-        if model_parameter and isinstance(machine_config["model"], str):
-            parameters = dict(model_parameter)
+        if isinstance(machine_config["model"], str):
+            # expand whenever the model is a string (reference cli.py:166):
+            # a jinja-free template must still yaml-load — gating on
+            # --model-parameter would crash parameterless string configs
             machine_config["model"] = expand_model(
-                machine_config["model"], parameters
+                machine_config["model"], dict(model_parameter or ())
             )
 
         machine = Machine.from_config(
@@ -190,23 +199,37 @@ def build(
             for score in get_all_score_strings(machine_out):
                 print(score)
 
+    except click.ClickException:
+        raise  # a usage error, not a build failure: click prints it cleanly
     except Exception:
-        traceback.print_exc()
-        exc_type, exc_value, exc_traceback = sys.exc_info()
-        exit_code = _exceptions_reporter.exception_exit_code(exc_type)
-        if exceptions_reporter_file:
-            _exceptions_reporter.safe_report(
-                ReportLevel.get_by_name(
-                    exceptions_report_level, ReportLevel.EXIT_CODE
-                ),
-                exc_type,
-                exc_value,
-                exc_traceback,
-                exceptions_reporter_file,
-                max_message_len=2024 - 500,
-            )
-        sys.exit(exit_code)
+        _report_exception_and_exit(
+            exceptions_reporter_file, exceptions_report_level
+        )
     return 0
+
+
+def _report_exception_and_exit(
+    exceptions_reporter_file: str, exceptions_report_level: str
+):
+    """Shared failure plumbing for the builder commands: print the
+    traceback, write the k8s termination-message report, exit with the
+    exception's stable code (one copy — build and batch-build must not
+    drift)."""
+    traceback.print_exc()
+    exc_type, exc_value, exc_traceback = sys.exc_info()
+    exit_code = _exceptions_reporter.exception_exit_code(exc_type)
+    if exceptions_reporter_file:
+        _exceptions_reporter.safe_report(
+            ReportLevel.get_by_name(
+                exceptions_report_level, ReportLevel.EXIT_CODE
+            ),
+            exc_type,
+            exc_value,
+            exc_traceback,
+            exceptions_reporter_file,
+            max_message_len=2024 - 500,
+        )
+    sys.exit(exit_code)
 
 
 @click.command("batch-build")
@@ -256,6 +279,7 @@ def build(
     "their chunk finishes and an interrupted fleet build resumes from "
     "cache instead of retraining",
 )
+@_reporter_options
 def batch_build(
     config_file: str,
     output_dir: str,
@@ -266,6 +290,8 @@ def batch_build(
     num_processes: int,
     process_id: int,
     model_register_dir: str,
+    exceptions_reporter_file: str,
+    exceptions_report_level: str,
 ):
     """
     Train EVERY machine in a config in one SPMD program on the device mesh
@@ -273,41 +299,55 @@ def batch_build(
     --coordinator-address/--num-processes/--process-id the mesh spans hosts
     and each host trains + saves its shard of the fleet.
     """
-    from gordo_tpu.parallel import BatchedModelBuilder, distributed
-    from gordo_tpu.workflow.normalized_config import NormalizedConfig
+    # same exceptions-reporter/exit-code plumbing as `build`: the workflow
+    # template wires EXCEPTIONS_REPORTER_FILE + terminationMessagePath to
+    # the chunk workers too — a fleet failure must be diagnosable from the
+    # k8s termination message with a stable exit code
+    try:
+        from gordo_tpu.parallel import BatchedModelBuilder, distributed
+        from gordo_tpu.workflow.normalized_config import NormalizedConfig
 
-    distributed.initialize(coordinator_address, num_processes, process_id)
-    native.prebuild(block=True)
-    from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
+        distributed.initialize(coordinator_address, num_processes, process_id)
+        native.prebuild(block=True)
+        from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
 
-    setup_persistent_xla_cache()
-    with open(config_file) as f:
-        config = yaml.safe_load(f)
-    norm = NormalizedConfig(config, project_name=project_name)
-    selected = norm.machines
-    if machines:
-        wanted = {name.strip() for name in machines.split(",") if name.strip()}
-        by_name = {m.name: m for m in norm.machines}
-        missing = wanted - set(by_name)
-        if missing:
-            raise click.ClickException(
-                f"--machines names not in config: {sorted(missing)}"
+        setup_persistent_xla_cache()
+        with open(config_file) as f:
+            config = yaml.safe_load(f)
+        norm = NormalizedConfig(config, project_name=project_name)
+        selected = norm.machines
+        if machines:
+            wanted = {
+                name.strip() for name in machines.split(",") if name.strip()
+            }
+            by_name = {m.name: m for m in norm.machines}
+            missing = wanted - set(by_name)
+            if missing:
+                raise click.ClickException(
+                    f"--machines names not in config: {sorted(missing)}"
+                )
+            selected = [by_name[name] for name in sorted(wanted)]
+        builder = BatchedModelBuilder(
+            selected,
+            serial_fallback=not no_serial_fallback,
+            output_dir=output_dir,
+            model_register_dir=model_register_dir,
+        )
+        # the builder persists every machine as soon as its chunk finishes
+        # (checkpoint/resume); reporting stays here, after the fleet
+        # completes
+        results = builder.build()
+        for model, machine_out in results:
+            machine_out.report()
+            click.echo(
+                f"built: {machine_out.name} -> "
+                f"{os.path.join(output_dir, machine_out.name)}"
             )
-        selected = [by_name[name] for name in sorted(wanted)]
-    builder = BatchedModelBuilder(
-        selected,
-        serial_fallback=not no_serial_fallback,
-        output_dir=output_dir,
-        model_register_dir=model_register_dir,
-    )
-    # the builder persists every machine as soon as its chunk finishes
-    # (checkpoint/resume); reporting stays here, after the fleet completes
-    results = builder.build()
-    for model, machine_out in results:
-        machine_out.report()
-        click.echo(
-            f"built: {machine_out.name} -> "
-            f"{os.path.join(output_dir, machine_out.name)}"
+    except click.ClickException:
+        raise  # a usage error (e.g. unknown --machines name), not a failure
+    except Exception:
+        _report_exception_and_exit(
+            exceptions_reporter_file, exceptions_report_level
         )
     return 0
 

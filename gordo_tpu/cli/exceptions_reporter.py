@@ -63,12 +63,6 @@ class ExceptionsReporter:
                 return exit_code
         return self.default_exit_code
 
-    @staticmethod
-    def trim_message(message: str, max_length: int) -> str:
-        if len(message) > max_length:
-            return message[: max_length - 3] + "..."
-        return message
-
     def report(
         self,
         level: ReportLevel,
@@ -79,18 +73,68 @@ class ExceptionsReporter:
         max_message_len: Optional[int] = None,
     ):
         doc: dict = {}
+        tb_original = ""
         if exc_type is not None:
             if level.value >= ReportLevel.TYPE.value:
                 doc["type"] = exc_type.__name__
             if level.value >= ReportLevel.MESSAGE.value:
-                message = replace_all_non_ascii_chars(str(exc_value))
-                if max_message_len is not None:
-                    message = self.trim_message(message, max_message_len)
-                doc["message"] = message
+                doc["message"] = replace_all_non_ascii_chars(str(exc_value))
             if level.value >= ReportLevel.TRACEBACK.value and exc_traceback is not None:
-                tb = "".join(traceback.format_tb(exc_traceback))
-                doc["traceback"] = replace_all_non_ascii_chars(tb)
+                tb_original = replace_all_non_ascii_chars(
+                    "".join(traceback.format_tb(exc_traceback))
+                )
+                doc["traceback"] = tb_original
         doc["exit_code"] = self.exception_exit_code(exc_type)
+        if max_message_len is not None:
+            # ONE budgeting mechanism on the WHOLE serialized document (the
+            # k8s termination message hard-caps ~2024B and kubelet truncates
+            # larger files mid-JSON; field-local budgets can't see JSON
+            # escaping or framing). Shrink order with floors, so neither
+            # field can starve the other: traceback keeps its INNERMOST
+            # frames (the failure site), message keeps its head.
+            MARKER = "...(trimmed)...\n"
+            msg_original = doc.get("message", "")
+
+            def _doc_len() -> int:
+                return len(json.dumps(doc))
+
+            def _shrink(
+                field: str, keep_tail: bool, floor: int, prefix: int = 0
+            ) -> None:
+                # drop chars from the un-kept side (after any protected
+                # prefix) until the doc fits or the field hits its floor
+                while _doc_len() > max_message_len:
+                    value = doc.get(field) or ""
+                    if len(value) <= floor:
+                        return
+                    cut = max((_doc_len() - max_message_len) // 2, 1)
+                    cut = min(cut, len(value) - floor)
+                    if keep_tail:
+                        doc[field] = value[:prefix] + value[prefix + cut:]
+                    else:
+                        doc[field] = value[:-cut]
+
+            if doc.get("traceback"):
+                # marker attached up front so its bytes are inside the
+                # budget; the shrink's protected prefix keeps it intact
+                doc["traceback"] = MARKER + doc["traceback"]
+            n_mark = len(MARKER)
+            _shrink("traceback", keep_tail=True, floor=n_mark + 200, prefix=n_mark)
+            _shrink("message", keep_tail=False, floor=120)
+            _shrink("traceback", keep_tail=True, floor=n_mark, prefix=n_mark)
+            _shrink("message", keep_tail=False, floor=0)
+            if doc.get("traceback") == MARKER + tb_original:
+                # nothing was actually removed: drop the marker
+                doc["traceback"] = tb_original
+            if doc.get("message") and doc["message"] != msg_original:
+                # mark a truncated message too — an operator must not take
+                # cut-off text for the full error. In-place (same length),
+                # so the budget is untouched
+                doc["message"] = (
+                    doc["message"][:-3] + "..."
+                    if len(doc["message"]) > 3
+                    else "..."
+                )
         json.dump(doc, report_file)
 
     def safe_report(

@@ -226,6 +226,14 @@ def resample(
         raise RuntimeError("native library unavailable")
     ts_ns = np.ascontiguousarray(ts_ns, dtype=np.int64)
     values = np.ascontiguousarray(values, dtype=np.float64)
+    if len(values) != len(ts_ns):
+        # the C kernel reads vals[0:len(ts_ns)] — a mismatched pair would
+        # be an out-of-bounds heap read aggregating garbage into training
+        # data, not a Python error
+        raise ValueError(
+            f"timestamps and values length mismatch: {len(ts_ns)} vs "
+            f"{len(values)}"
+        )
     aggs = np.array([AGG_CODES[m] for m in methods], dtype=np.int32)
     out = np.empty((len(methods), n_buckets), dtype=np.float64)
     rc = lib.gordo_resample(

@@ -69,7 +69,10 @@ def _build_step(step: Union[str, Dict[str, Dict[str, Any]]]):
         if StepClass is None:
             raise ImportError(f'Could not locate path: "{import_str}"')
 
-        params = step.get(import_str, dict())
+        # `or {}`: a step written as `Class:` with an empty YAML body parses
+        # to {import_str: None} — the key EXISTS, so .get's default never
+        # applies and **None would TypeError instead of a no-arg construct
+        params = step.get(import_str) or {}
 
         if hasattr(StepClass, "from_definition"):
             return getattr(StepClass, "from_definition")(params)

@@ -11,8 +11,10 @@ logger = logging.getLogger(__name__)
 
 def get_model_output(model, X) -> np.ndarray:
     """Predict, falling back to transform when the model has no predict."""
-    try:
+    # hasattr, not except AttributeError: catching would also swallow an
+    # AttributeError raised INSIDE a real predict (e.g. an unfitted custom
+    # estimator) and silently serve transform output with a 200
+    if hasattr(model, "predict"):
         return model.predict(X)
-    except AttributeError:
-        logger.debug("Model has no predict, falling back to transform")
-        return model.transform(X)
+    logger.debug("Model has no predict, falling back to transform")
+    return model.transform(X)

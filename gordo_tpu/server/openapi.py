@@ -138,6 +138,14 @@ def openapi_document() -> dict:
                     "responses": {"200": {"description": "Metadata document"}},
                 }
             },
+            f"{machine}/healthcheck": {
+                "get": {
+                    "summary": "Per-machine probe (alias of /metadata: 200 "
+                    "iff the machine's artifact is loadable)",
+                    "parameters": [_PROJECT_PARAM, _NAME_PARAM, _REVISION_PARAM],
+                    "responses": {"200": {"description": "Machine servable"}},
+                }
+            },
             f"{machine}/download-model": {
                 "get": {
                     "summary": "Serialized model artifact",

@@ -148,11 +148,28 @@ class GordoServerPrometheusMetrics:
         ``timeit.default_timer()`` reading at request start (kept per-request
         so concurrent requests under a threaded server can't race)."""
         duration = timeit.default_timer() - start_time
-        match = _NAME_RE.search(request.path)
-        gordo_name = match.group(1) if match else ""
+        # label by the MATCHED url rule (placed in the environ by
+        # dispatch_request), never the raw path: raw paths give unbounded
+        # label cardinality — every unique URL a scanner probes would mint
+        # a fresh timeseries in the histogram and counter until the worker
+        # (and the scrape payload) bloats. gordo_name is gated the same
+        # way: parsing it out of an UNMATCHED path would mint one label
+        # value per random /gordo/v0/*/*/ probe
+        rule = request.environ.get("gordo_tpu.rule")
+        path = rule if rule is not None else "(unmatched)"
+        if rule is not None and response.status_code not in (404, 405, 410):
+            # per-machine rules match ANY name; a scanner probing
+            # /gordo/v0/p/<random>/metadata gets a matched rule + 404 (and
+            # a GET on a POST-only route a matched rule + 405) — only
+            # label names the server actually resolved (404 = unknown
+            # machine, 405 = never dispatched, 410 = unknown revision)
+            match = _NAME_RE.search(request.path)
+            gordo_name = match.group(1) if match else ""
+        else:
+            gordo_name = ""
         labels = dict(
             method=request.method,
-            path=request.path,
+            path=path,
             status_code=str(response.status_code),
             gordo_name=gordo_name,
             project=self.project,

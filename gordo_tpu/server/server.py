@@ -21,7 +21,7 @@ import timeit
 from typing import Any, Dict, Optional
 
 import simplejson
-from werkzeug.exceptions import HTTPException
+from werkzeug.exceptions import HTTPException, MethodNotAllowed
 from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
@@ -154,7 +154,25 @@ class GordoServer:
         ctx = RequestContext(self.config)
         adapter = self.url_map.bind_to_environ(request.environ)
         try:
-            endpoint, values = adapter.match()
+            rule, values = adapter.match(return_rule=True)
+            endpoint = rule.endpoint
+            # the metrics layer labels by the matched RULE, not the raw
+            # path: raw paths are unbounded label cardinality (any bot
+            # scanning random URLs would mint a new timeseries per hit)
+            request.environ["gordo_tpu.rule"] = rule.rule
+        except MethodNotAllowed as exc:
+            # the PATH matched a real route (wrong method): keep endpoint
+            # attribution in the metrics instead of lumping the 405 into
+            # the unmatched bucket with scanner noise
+            if exc.valid_methods:
+                try:
+                    rule, _ = adapter.match(
+                        method=exc.valid_methods[0], return_rule=True
+                    )
+                    request.environ["gordo_tpu.rule"] = rule.rule
+                except HTTPException:
+                    pass
+            return exc.get_response()
         except HTTPException as exc:
             return exc.get_response()
 

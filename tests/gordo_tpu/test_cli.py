@@ -165,3 +165,64 @@ def test_report_levels(tmp_path):
     assert doc["type"] == "ValueError"
     assert "?" in doc["message"]  # non-ascii scrubbed
     assert "traceback" in doc
+
+
+def test_build_string_model_without_parameters(tmp_path):
+    """A plain-string (jinja-free) model config must expand and build even
+    with no --model-parameter: gating the yaml-load on parameters crashed
+    the reference-supported string form."""
+    machine = {
+        "name": "str-model",
+        "dataset": {
+            "type": "RandomDataset",
+            "tags": ["s-0", "s-1"],
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": "2019-01-02T00:00:00+00:00",
+        },
+        "model": (
+            "gordo_tpu.models.models.AutoEncoder:\n"
+            "  kind: feedforward_hourglass\n"
+            "  epochs: 1\n"
+        ),
+    }
+    out = tmp_path / "out"
+    result = CliRunner().invoke(
+        gordo,
+        ["build", json.dumps(machine), str(out)],
+    )
+    assert result.exit_code == 0, result.output
+    assert (out / "model.pkl").exists()
+
+
+def test_traceback_report_fits_termination_message(tmp_path):
+    """TRACEBACK-level reports must fit the ~2024-byte k8s termination
+    message: kubelet truncates larger files mid-JSON."""
+    reporter = ExceptionsReporter([(Exception, 1)])
+    try:
+        def deep(n):
+            if n == 0:
+                # quotes/newlines escape to 2 bytes each in JSON — the cap
+                # must hold on the ESCAPED form
+                raise ValueError("boom " + '"\n' * 400)
+            return deep(n - 1)
+
+        deep(40)
+    except ValueError:
+        import sys
+
+        exc_type, exc_value, exc_tb = sys.exc_info()
+    path = tmp_path / "report.json"
+    # the natural cap itself, no caller slack: the guarantee is on the
+    # WHOLE serialized document
+    reporter.safe_report(
+        ReportLevel.TRACEBACK, exc_type, exc_value, exc_tb, str(path),
+        max_message_len=2024,
+    )
+    blob = path.read_bytes()
+    assert len(blob) <= 2024, len(blob)
+    doc = json.loads(blob)  # still valid JSON
+    assert doc["type"] == "ValueError"
+    # the innermost frames (the failure site) are what survives the trim,
+    # and the trim MARKER survives every shrink stage
+    assert "deep" in doc["traceback"]
+    assert doc["traceback"].startswith("...(trimmed)...")

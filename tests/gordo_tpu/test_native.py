@@ -143,3 +143,13 @@ def test_no_native_env_kill_switch(monkeypatch):
     # reset for other tests
     monkeypatch.delenv("GORDO_TPU_NO_NATIVE")
     monkeypatch.setattr(native, "_load_failed", False)
+
+
+def test_resample_rejects_length_mismatch():
+    """Mismatched timestamp/value arrays must raise in Python — the C
+    kernel would read out of bounds."""
+    ts = np.arange(10, dtype=np.int64) * 600_000_000_000
+    vals = np.ones(8)
+    with pytest.raises(ValueError, match="length mismatch"):
+        native.resample(ts, vals, origin_ns=0, bucket_ns=600_000_000_000,
+                        n_buckets=10, methods=["mean"])

@@ -141,3 +141,23 @@ def test_dumps_loads_roundtrip():
     model2 = serializer.loads(blob)
     assert isinstance(model2, AutoEncoder)
     assert model2.kind == "feedforward_symmetric"
+
+
+def test_step_with_empty_yaml_body_constructs_no_arg():
+    """`- sklearn.preprocessing.MinMaxScaler:` (trailing colon, empty body)
+    parses to {path: None} — must construct with no args, not TypeError."""
+    import yaml
+
+    from gordo_tpu import serializer
+
+    definition = yaml.safe_load(
+        """
+sklearn.pipeline.Pipeline:
+  steps:
+    - sklearn.preprocessing.MinMaxScaler:
+    - gordo_tpu.models.models.AutoEncoder:
+        kind: feedforward_hourglass
+"""
+    )
+    pipe = serializer.from_definition(definition)
+    assert type(pipe.steps[0][1]).__name__ == "MinMaxScaler"

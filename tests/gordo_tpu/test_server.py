@@ -278,10 +278,7 @@ def test_openapi_spec_matches_url_map(client):
         return rule_str.replace("<", "{").replace(">", "}")
 
     rule_paths = {
-        to_openapi(r.rule)
-        for r in GordoServer.url_map.iter_rules()
-        # per-machine healthcheck aliases metadata; not separately documented
-        if not r.rule.endswith("/<gordo_name>/healthcheck")
+        to_openapi(r.rule) for r in GordoServer.url_map.iter_rules()
     }
     spec_paths = set(spec["paths"])
     assert rule_paths <= spec_paths, rule_paths - spec_paths
@@ -440,3 +437,34 @@ def test_warmup_rows_env_parsing(monkeypatch):
     for bad in ("128;1024", "128, abc", " , ", "0", "-5"):
         monkeypatch.setenv("GORDO_TPU_WARMUP_ROWS", bad)
         assert warmup._default_bucket_rows() == warmup.DEFAULT_BUCKET_ROWS
+
+
+def test_prometheus_labels_bounded_for_scanner_paths(model_collection_directory):
+    """Metrics label by the MATCHED route, never the raw path: a scanner
+    probing random URLs must not mint unbounded timeseries."""
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    registry = CollectorRegistry()
+    app = build_app(
+        {
+            "MODEL_COLLECTION_DIR": model_collection_directory,
+            "ENABLE_PROMETHEUS": True,
+            "PROJECT": "test-proj",
+        },
+        prometheus_registry=registry,
+    )
+    c = app.test_client()
+    for i in range(20):
+        c.get(f"/wp-admin/{i}/.env")
+    c.get("/healthcheck")
+    for i in range(10):
+        c.get(f"/gordo/v0/proj/scan-{i}/whatever")      # matches no rule
+        c.get(f"/gordo/v0/proj/scan-{i}/metadata")      # matches, 404s
+        c.get(f"/gordo/v0/proj/scan-{i}/prediction")    # matches, 405s
+    body = generate_latest(registry).decode()
+    assert "wp-admin" not in body
+    assert "scan-" not in body  # gordo_name only for RESOLVED machines
+    # ...but the 405 keeps endpoint attribution (matched rule, no name)
+    assert 'path="/gordo/v0/<gordo_project>/<gordo_name>/prediction"' in body
+    assert 'path="(unmatched)"' in body
+    assert 'path="/healthcheck"' in body
