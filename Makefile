@@ -27,6 +27,8 @@ dryrun:
 smoke:
 	python -m gordo_tpu.cli workflow generate \
 		--machine-config examples/config.yaml --project-name smoke-test \
+		--client-start-date 2019-01-01T00:00:00Z \
+		--client-end-date 2019-01-02T00:00:00Z \
 		| python -m gordo_tpu.cli workflow validate -
 
 bench:

@@ -619,11 +619,14 @@ def _setup_backend(argv) -> None:
                 file=sys.stderr,
             )
             _reexec_clean_cpu(argv)
+            # normally unreachable (execve replaces the process) — but the
+            # call no-ops if GORDO_TPU_BENCH_REEXEC leaked in without
+            # JAX_PLATFORMS=cpu, and then the process MUST still be forced
+            # off the wedged accelerator backend, with the same 8-virtual-
+            # device mesh as a genuine re-exec (backend not initialized
+            # yet, so the env flag still takes effect)
             jax.config.update("jax_platforms", "cpu")
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8"
-            ).strip()
+            _ensure_virtual_cpu_mesh(os.environ)
 
     # CPU (whether fallback or a CPU-only host) can't absorb the TPU-sized
     # windowed fleets — bf16 is emulated there — so shrink the
@@ -636,6 +639,18 @@ def _setup_backend(argv) -> None:
         if "BENCH_WINDOWED_DTYPE" not in os.environ:
             WINDOWED_DTYPE = "float32"
         os.environ.setdefault("BENCH_AB_ROUNDS", "5")
+
+
+def _ensure_virtual_cpu_mesh(env) -> None:
+    """Append the 8-virtual-device flag to ``env['XLA_FLAGS']`` unless a
+    device count is already pinned — the CPU fallback must run the same
+    fake-TPU mesh as the tests/dryrun, whether it reaches CPU via the
+    clean re-exec or the in-process config fallback."""
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 
 def _reexec_clean_cpu(argv) -> None:
@@ -652,6 +667,10 @@ def _reexec_clean_cpu(argv) -> None:
     env["GORDO_TPU_BENCH_REEXEC"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = ""
+    # the flag must ride the exec env — execve never returns, so any
+    # post-call configuration would be dead code (r3's CPU fallback
+    # records show n_devices: 1 because exactly that happened)
+    _ensure_virtual_cpu_mesh(env)
     os.execve(sys.executable, [sys.executable, __file__, *argv[1:]], env)
 
 

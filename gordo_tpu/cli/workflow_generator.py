@@ -348,6 +348,19 @@ def _parse_custom_envs(raw: str) -> List[dict]:
             raise click.ClickException(
                 f"EnvVar entry {env['name']!r} needs 'value' or 'valueFrom'"
             )
+        if (
+            "value" in env
+            and env["value"] is not None  # explicit null = unset (k8s, and
+            # the render-time validator, both allow it)
+            and not isinstance(env["value"], str)
+        ):
+            # fail at the flag with the actionable message — the render-time
+            # validator's generic error points the user at the template,
+            # not at their CLI input
+            raise click.ClickException(
+                f"EnvVar {env['name']!r} value must be a JSON string, got "
+                f"{type(env['value']).__name__} (quote it)"
+            )
     return envs
 
 
@@ -398,6 +411,33 @@ def generate_workflow_docs(
             f"--project-revision must be numeric, got {project_revision!r} "
             "(it is ordered numerically by the single-workflow guard)"
         )
+    if enable_clients and not (client_start_date and client_end_date):
+        # the rendered gordo-client tasks run `predict <start> <end>`;
+        # empty dates would make every client task fail its date parse,
+        # Argo retry each 5x, and the whole client layer of the DAG fail —
+        # on any default invocation. Fail HERE with the actionable knob.
+        raise click.ClickException(
+            "--client-start-date and --client-end-date are required when "
+            "clients are enabled (use --disable-clients to generate a "
+            "workflow without prediction clients)"
+        )
+    if enable_clients:
+        from datetime import datetime
+
+        for knob, value in (
+            ("--client-start-date", client_start_date),
+            ("--client-end-date", client_end_date),
+        ):
+            try:
+                parsed = datetime.fromisoformat(value.replace("Z", "+00:00"))
+            except ValueError:
+                raise click.ClickException(
+                    f"{knob} {value!r} is not an ISO-8601 timestamp"
+                )
+            if parsed.tzinfo is None:
+                raise click.ClickException(
+                    f"{knob} {value!r} needs a timezone (e.g. trailing Z)"
+                )
     config = get_dict_from_yaml(machine_config)
     norm = NormalizedConfig(config, project_name=project_name)
 
@@ -444,6 +484,15 @@ def generate_workflow_docs(
     else:
         workflow_groups = [list(norm.machines)]
 
+    # the server HPA is ONE shared per-project resource: its default
+    # ceiling scales with the project's machine count, never a
+    # split-workflow group's (whichever doc applied last would set it)
+    max_replicas = (
+        ml_server_max_replicas
+        if ml_server_max_replicas is not None
+        else 10 * len(norm.machines)
+    )
+
     docs: List[str] = []
     for group_idx, group in enumerate(workflow_groups):
         chunks = chunk_machines(group, machines_per_tpu_worker)
@@ -473,11 +522,6 @@ def generate_workflow_docs(
             f"group-{group_idx}.yaml"
         )
 
-        max_replicas = (
-            ml_server_max_replicas
-            if ml_server_max_replicas is not None
-            else 10 * len(group)
-        )
         context = {
             "project_name": project_name,
             "project_revision": project_revision,

@@ -57,6 +57,30 @@ def _check_container(c: Dict[str, Any], where: str, errors: List[str]):
             errors.append(f"{where}: volumeMount needs name and mountPath")
 
 
+def _check_template_ref(entry: Dict[str, Any], where: str,
+                        template_names: set, errors: List[str]):
+    """One task/step must reference a template by name, templateRef, or
+    (Argo >= 3.2) an inline definition; a named ref must exist."""
+    template_ref = entry.get("templateRef")
+    if template_ref is not None and not isinstance(template_ref, dict):
+        errors.append(
+            f"{where}: templateRef must be a mapping with a name, got "
+            f"{template_ref!r}"
+        )
+        template_ref = None
+    ref = (
+        entry.get("template")
+        or (template_ref or {}).get("name")
+        or entry.get("inline")
+    )
+    if entry.get("template") and entry["template"] not in template_names:
+        errors.append(
+            f"{where}: references undefined template {entry['template']!r}"
+        )
+    elif not ref:
+        errors.append(f"{where}: no template ref")
+
+
 def _check_dag(dag: Dict[str, Any], tmpl_name: str, template_names: set,
                errors: List[str]):
     tasks = dag.get("tasks") or []
@@ -64,18 +88,17 @@ def _check_dag(dag: Dict[str, Any], tmpl_name: str, template_names: set,
     deps: Dict[str, List[str]] = {}
     for task in tasks:
         t_name = task.get("name")
-        _check_name(str(t_name), f"dag {tmpl_name} task", errors)
+        _check_name(t_name, f"dag {tmpl_name} task", errors)
+        if not isinstance(t_name, str):
+            # name error already recorded; an unhashable name would crash
+            # the duplicate/dependency bookkeeping below
+            continue
         if t_name in task_names:
             errors.append(f"dag {tmpl_name}: duplicate task name {t_name!r}")
         task_names.add(t_name)
-        ref = task.get("template") or (task.get("templateRef") or {}).get("name")
-        if task.get("template") and task["template"] not in template_names:
-            errors.append(
-                f"dag {tmpl_name} task {t_name}: references undefined "
-                f"template {task['template']!r}"
-            )
-        elif not ref:
-            errors.append(f"dag {tmpl_name} task {t_name}: no template ref")
+        _check_template_ref(
+            task, f"dag {tmpl_name} task {t_name}", template_names, errors
+        )
         raw = task.get("dependencies") or []
         if isinstance(raw, str):
             raw = raw.split()
@@ -178,6 +201,33 @@ def validate_workflow_doc(doc: Dict[str, Any]) -> List[str]:
                         )
         if tmpl.get("dag"):
             _check_dag(tmpl["dag"], t_name, template_names, errors)
+        if tmpl.get("steps"):
+            # steps templates carry the same template references as dag
+            # tasks (a list of parallel-step lists) — an unchecked steps
+            # template would ship a workflow Argo rejects despite this
+            # gate passing
+            step_names: set = set()
+            for group in tmpl["steps"]:
+                for step in group if isinstance(group, list) else [group]:
+                    if not isinstance(step, dict):
+                        errors.append(
+                            f"steps {t_name}: step entry must be a "
+                            f"mapping, got {step!r}"
+                        )
+                        continue
+                    s_name = step.get("name")
+                    _check_name(s_name, f"steps {t_name} step", errors)
+                    if isinstance(s_name, str):
+                        if s_name in step_names:
+                            errors.append(
+                                f"steps {t_name}: duplicate step name "
+                                f"{s_name!r}"
+                            )
+                        step_names.add(s_name)
+                    _check_template_ref(
+                        step, f"steps {t_name} step {s_name}",
+                        template_names, errors,
+                    )
     return errors
 
 

@@ -29,10 +29,19 @@ class TimestampNotTZAware(ValueError):
 
 def _tz_aware_timestamp_constructor(loader, node):
     value = loader.construct_yaml_timestamp(node)
-    if isinstance(value, datetime) and value.tzinfo is None:
+    if isinstance(value, datetime):
+        if value.tzinfo is None:
+            raise TimestampNotTZAware(
+                f"Provide timezone to timestamp {node.value!r} "
+                "(e.g. '2019-01-01T00:00:00Z')"
+            )
+    else:
+        # a date-only timestamp (unquoted 2019-01-01) constructs a
+        # datetime.date — inherently tz-naive, and it would slip past the
+        # datetime check into code expecting tz-aware datetimes
         raise TimestampNotTZAware(
-            f"Provide timezone to timestamp {node.value!r} "
-            "(e.g. '2019-01-01T00:00:00Z')"
+            f"Provide a full timezone-aware timestamp for {node.value!r} "
+            "(e.g. '2019-01-01T00:00:00Z'), not a bare date"
         )
     return value
 

@@ -23,9 +23,24 @@ if [[ -n "${DEBUG_SHOW_WORKFLOW}" ]]; then
   echo "===CONFIG==="; cat "$CONFIG_FILE"
 fi
 
+# prediction clients need the date range they will predict over; set
+# CLIENT_START_DATE/CLIENT_END_DATE, or leave unset for a build-only DAG
+CLIENT_DATE_ARGS=()
+if [[ -n "${CLIENT_START_DATE:-}" && -n "${CLIENT_END_DATE:-}" ]]; then
+  CLIENT_DATE_ARGS=(--client-start-date "$CLIENT_START_DATE" \
+                    --client-end-date "$CLIENT_END_DATE")
+elif [[ -n "${CLIENT_START_DATE:-}" || -n "${CLIENT_END_DATE:-}" ]]; then
+  echo "ERROR: set BOTH CLIENT_START_DATE and CLIENT_END_DATE (or neither" \
+       "for a build-only DAG)" >&2
+  exit 2
+else
+  CLIENT_DATE_ARGS=(--disable-clients)
+fi
+
 gordo-tpu workflow generate \
     --machine-config "$CONFIG_FILE" \
     --project-name "${PROJECT_NAME:?PROJECT_NAME must be set}" \
+    "${CLIENT_DATE_ARGS[@]}" \
     --output-file "$GENERATED"
 
 if [[ -n "${DEBUG_SHOW_WORKFLOW}" ]]; then

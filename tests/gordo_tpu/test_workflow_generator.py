@@ -55,6 +55,8 @@ def config_file(tmp_path):
 
 
 def _render(config_file, **overrides) -> list:
+    overrides.setdefault("client_start_date", "2019-01-01T00:00:00Z")
+    overrides.setdefault("client_end_date", "2019-01-02T00:00:00Z")
     content = generate_workflow_docs(
         machine_config=config_file, project_name="test-proj", **overrides
     )
@@ -208,6 +210,10 @@ def test_generate_via_cli(config_file, tmp_path):
             config_file,
             "--project-name",
             "cli-proj",
+            "--client-start-date",
+            "2019-01-01T00:00:00Z",
+            "--client-end-date",
+            "2019-01-02T00:00:00Z",
             "--output-file",
             str(out),
         ],
@@ -258,7 +264,9 @@ def test_multihost_slice_rendering():
     """--tpu-workers-per-slice > 1 must render per-chunk coordinator
     Services and one rank-parameterized builder pod per slice host."""
     docs = generate_workflow_docs(
-        _config_yaml(4), project_name="mh-proj", tpu_workers_per_slice=2
+        _config_yaml(4), project_name="mh-proj", tpu_workers_per_slice=2,
+        client_start_date="2019-01-01T00:00:00Z",
+        client_end_date="2019-01-02T00:00:00Z",
     )
     parsed = [d for d in yaml.safe_load_all(docs) if d]
     templates = {t["name"]: t for d in parsed for t in d["spec"]["templates"]}
@@ -289,7 +297,11 @@ def test_multihost_slice_rendering():
 
 
 def test_singlehost_has_no_coordinator():
-    docs = generate_workflow_docs(_config_yaml(2), project_name="sh-proj")
+    docs = generate_workflow_docs(
+        _config_yaml(2), project_name="sh-proj",
+        client_start_date="2019-01-01T00:00:00Z",
+        client_end_date="2019-01-02T00:00:00Z",
+    )
     parsed = [d for d in yaml.safe_load_all(docs) if d]
     names = [t["name"] for d in parsed for t in d["spec"]["templates"]]
     assert "gordo-coordinator-service" not in names
@@ -351,7 +363,9 @@ def test_workflow_validator_catches_broken_docs(config_file):
     )
 
     content = generate_workflow_docs(
-        machine_config=config_file, project_name="test-proj"
+        machine_config=config_file, project_name="test-proj",
+        client_start_date="2019-01-01T00:00:00Z",
+        client_end_date="2019-01-02T00:00:00Z",
     )
     validate_workflow_docs(content)  # rendered docs are valid
 
@@ -393,3 +407,140 @@ def test_workflow_validator_catches_broken_docs(config_file):
     del bad["spec"]["entrypoint"]
     with pytest.raises(WorkflowValidationError, match="entrypoint"):
         validate_workflow_docs(yaml.safe_dump(bad))
+
+
+def test_clients_require_dates():
+    """Enabled clients with empty dates would render `predict "" ""` tasks
+    that all fail in Argo — generation must fail with the actionable knob
+    instead, and --disable-clients must lift the requirement."""
+    import click
+
+    with pytest.raises(click.ClickException, match="client-start-date"):
+        generate_workflow_docs(_config_yaml(2), project_name="d-proj")
+    # malformed or tz-naive dates fail at the same gate, not in every
+    # rendered client task's Argo retry loop
+    with pytest.raises(click.ClickException, match="ISO-8601"):
+        generate_workflow_docs(
+            _config_yaml(2), project_name="d-proj",
+            client_start_date="banana",
+            client_end_date="2019-01-02T00:00:00Z",
+        )
+    with pytest.raises(click.ClickException, match="timezone"):
+        generate_workflow_docs(
+            _config_yaml(2), project_name="d-proj",
+            client_start_date="2019-01-01T00:00:00",
+            client_end_date="2019-01-02T00:00:00Z",
+        )
+    docs = generate_workflow_docs(
+        _config_yaml(2), project_name="d-proj", enable_clients=False
+    )
+    parsed = [d for d in yaml.safe_load_all(docs) if d]
+    dag_tasks = [
+        t["name"]
+        for d in parsed
+        for tpl in d["spec"]["templates"]
+        for t in (tpl.get("dag", {}) or {}).get("tasks", [])
+    ]
+    assert not any(name.startswith("client-") for name in dag_tasks)
+
+
+def test_hpa_max_replicas_scales_with_project_not_group():
+    """The server HPA is ONE shared per-project resource; its default
+    ceiling must come from the project's machine count, not whichever
+    split-workflow group's doc happens to apply last."""
+    docs = generate_workflow_docs(
+        _config_yaml(35), project_name="hpa-proj", split_workflows=30,
+        client_start_date="2019-01-01T00:00:00Z",
+        client_end_date="2019-01-02T00:00:00Z",
+    )
+    parsed = [d for d in yaml.safe_load_all(docs) if d]
+    assert len(parsed) == 2  # 30 + 5
+    ceilings = {_max_replicas_of(d) for d in parsed}
+    assert ceilings == {350}, ceilings
+
+
+def _max_replicas_of(doc) -> int:
+    """The rendered HPA/ScaledObject ceiling inside one Workflow doc (the
+    HPA manifest is an embedded string, so regex the serialized doc)."""
+    import re
+
+    hits = re.findall(r"maxReplicas?(?:Count)?\D{0,4}?(\d+)", str(doc))
+    assert hits, "no maxReplicas in doc"
+    assert len(set(hits)) == 1, hits
+    return int(hits[0])
+
+
+def test_bare_date_rejected_as_tz_naive(tmp_path):
+    """Unquoted `2019-01-01` constructs a datetime.date — inherently
+    tz-naive; it must hit the same guard as naive datetimes instead of
+    slipping through into tz-aware comparisons downstream."""
+    from gordo_tpu.workflow.workflow_generator import (
+        TimestampNotTZAware,
+        get_dict_from_yaml,
+    )
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("machines:\n  - name: m\n    start: 2019-01-01\n")
+    with pytest.raises(TimestampNotTZAware, match="bare date"):
+        get_dict_from_yaml(str(cfg))
+
+
+def test_validator_checks_steps_template_references():
+    from gordo_tpu.workflow.validate import validate_workflow_doc
+
+    doc = {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": {"name": "w"},
+        "spec": {
+            "entrypoint": "main",
+            "templates": [
+                {
+                    "name": "main",
+                    "steps": [[{"name": "s1", "template": "missing"}]],
+                },
+            ],
+        },
+    }
+    errors = validate_workflow_doc(doc)
+    assert any("undefined template 'missing'" in e for e in errors)
+
+
+def test_validator_steps_edge_cases():
+    """Non-dict step entries report errors (not AttributeError); Argo 3.2+
+    inline steps count as a valid template ref."""
+    from gordo_tpu.workflow.validate import validate_workflow_doc
+
+    base = {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": {"name": "w"},
+    }
+    malformed = {
+        **base,
+        "spec": {
+            "entrypoint": "main",
+            "templates": [{"name": "main", "steps": [["oops"]]}],
+        },
+    }
+    errors = validate_workflow_doc(malformed)
+    assert any("must be a mapping" in e for e in errors)
+
+    inline = {
+        **base,
+        "spec": {
+            "entrypoint": "main",
+            "templates": [
+                {
+                    "name": "main",
+                    "steps": [[{
+                        "name": "s",
+                        "inline": {"container": {"image": "i", "command": ["x"]}},
+                    }]],
+                }
+            ],
+        },
+    }
+    assert not any(
+        "no template ref" in e for e in validate_workflow_doc(inline)
+    )
