@@ -622,9 +622,9 @@ def _setup_backend(argv) -> None:
             # normally unreachable (execve replaces the process) — but the
             # call no-ops if GORDO_TPU_BENCH_REEXEC leaked in without
             # JAX_PLATFORMS=cpu, and then the process MUST still be forced
-            # off the wedged accelerator backend, with the same 8-virtual-
-            # device mesh as a genuine re-exec (backend not initialized
-            # yet, so the env flag still takes effect)
+            # off the wedged accelerator backend, with the same core-capped
+            # virtual-device mesh as a genuine re-exec (backend not
+            # initialized yet, so the env flag still takes effect)
             jax.config.update("jax_platforms", "cpu")
             _ensure_virtual_cpu_mesh(os.environ)
 
@@ -642,14 +642,21 @@ def _setup_backend(argv) -> None:
 
 
 def _ensure_virtual_cpu_mesh(env) -> None:
-    """Append the 8-virtual-device flag to ``env['XLA_FLAGS']`` unless a
-    device count is already pinned — the CPU fallback must run the same
-    fake-TPU mesh as the tests/dryrun, whether it reaches CPU via the
-    clean re-exec or the in-process config fallback."""
+    """Give the CPU fallback a virtual device mesh (unless one is already
+    pinned in ``env['XLA_FLAGS']``) so fleet chunks shard across devices
+    like on a TPU slice. Capped at the core count: virtual devices beyond
+    physical cores add collective/partitioning overhead with no
+    parallelism (on a 1-core host an 8-device mesh was measured SLOWER
+    than 1 device)."""
     if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        try:
+            usable = len(os.sched_getaffinity(0))  # respects cgroup pinning
+        except (AttributeError, OSError):
+            usable = os.cpu_count() or 1
+        n = max(1, min(8, usable))
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
+            + f" --xla_force_host_platform_device_count={n}"
         ).strip()
 
 
