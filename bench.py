@@ -160,6 +160,10 @@ _WINDOWED_FAMILIES = {
         "gordo_tpu.models.models.TransformerAutoEncoder",
         {"kind": "transformer_model"},
     ),
+    "tcn_144": (
+        "gordo_tpu.models.models.TCNAutoEncoder",
+        {"kind": "tcn_model"},
+    ),
 }
 
 
@@ -205,7 +209,10 @@ def _torch_windowed_sec_per_machine(family: str, n_rows: int = 1008) -> float:
     last step's output through a Linear head — the lstm_symmetric dims=[64,32]
     schedule. Transformer mirror: Linear→d64 + sinusoidal positions + 2
     norm-first encoder blocks (4 heads, ff 128, causal mask) + last-step
-    Linear head — the transformer_model defaults.
+    Linear head — the transformer_model defaults. TCN mirror: 4 residual
+    blocks of two causal dilated Conv1d (filters 64, kernel 3, dilations
+    1/2/4/8, 1x1 residual projection on the channel change) + last-step
+    Linear head — the tcn_model defaults.
     """
     import math
 
@@ -236,6 +243,42 @@ def _torch_windowed_sec_per_machine(family: str, n_rows: int = 1008) -> float:
                 for cell in self.cells:
                     x, _ = cell(x)
                 return self.head(x[:, -1, :])
+
+    elif family == "tcn_144":
+
+        class _TCNBlock(torch.nn.Module):
+            def __init__(self, c_in, c_out, k, d):
+                super().__init__()
+                self.pad = (k - 1) * d
+                self.c1 = torch.nn.Conv1d(c_in, c_out, k, dilation=d)
+                self.c2 = torch.nn.Conv1d(c_out, c_out, k, dilation=d)
+                self.res = (
+                    torch.nn.Conv1d(c_in, c_out, 1) if c_in != c_out else None
+                )
+
+            def forward(self, x):  # (B, C, T)
+                import torch.nn.functional as F
+
+                h = torch.relu(self.c1(F.pad(x, (self.pad, 0))))
+                h = torch.relu(self.c2(F.pad(h, (self.pad, 0))))
+                r = x if self.res is None else self.res(x)
+                return torch.relu(h + r)
+
+        class Mirror(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                chans = [D, 64, 64, 64, 64]
+                self.blocks = torch.nn.ModuleList(
+                    _TCNBlock(i, o, 3, 2**n)
+                    for n, (i, o) in enumerate(zip(chans, chans[1:]))
+                )
+                self.head = torch.nn.Linear(64, D)
+
+            def forward(self, x):  # (B, T, D)
+                h = x.transpose(1, 2)
+                for block in self.blocks:
+                    h = block(h)
+                return self.head(h[:, :, -1])
 
     else:
 
@@ -535,6 +578,11 @@ def _run_section(name: str, extra_env: Optional[dict] = None) -> dict:
         # three drives (direct/batched/auto) x two archs, plus the probe
         # retry budget when the tunnel is wedged
         timeout = max(timeout, 3000)
+    if name == "windowed" and "BENCH_SECTION_TIMEOUT_WINDOWED" not in os.environ:
+        # four families (LSTM AE/forecast, Transformer, TCN), each with a
+        # fleet compile + steady-state build + a torch mirror — a CPU
+        # fallback needs more than the generic leash
+        timeout = max(timeout, 3600)
     env = None
     if extra_env:
         env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}}

@@ -337,3 +337,23 @@ def test_fused_qkv_matches_unfused_and_tp_disables_it():
     )
     blocks = [l for l in tp_spec.layers if isinstance(l, TransformerBlock)]
     assert blocks and all(not b.fuse_qkv for b in blocks)
+
+
+def test_causal_conv_matmul_form_matches_conv_general_dilated():
+    """The TCN causal conv is implemented as k shifted matmuls (XLA CPU has
+    no fast dilated-conv path — measured ~32x slower — and matmuls are the
+    MXU's native op). Pin it against lax.conv_general_dilated."""
+    rng = np.random.RandomState(0)
+    for dilation in (1, 2, 4, 8):
+        x = jnp.asarray(rng.rand(3, 50, 5).astype(np.float32))
+        w = jnp.asarray(rng.rand(3, 5, 7).astype(np.float32))
+        ref = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,),
+            padding=[((w.shape[0] - 1) * dilation, 0)],
+            rhs_dilation=(dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        got = nn._causal_conv1d(x, w, dilation)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
