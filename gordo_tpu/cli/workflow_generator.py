@@ -157,8 +157,10 @@ def workflow_cli():
 @click.option(
     "--ml-server-min-replicas",
     type=int,
-    default=1,
+    default=None,
     envvar=f"{PREFIX}_ML_SERVER_MIN_REPLICAS",
+    help="Default: --server-replicas (the Deployment itself pins no "
+    "replica count; the autoscaler owns scaling)",
 )
 @click.option(
     "--ml-server-hpa-cpu-target",
@@ -328,6 +330,17 @@ def workflow_validate_cli(workflow_file):
 workflow_cli.add_command(workflow_validate_cli)
 
 
+def _bounded_k8s_name(base: str, limit: int = 63) -> str:
+    """Truncate a k8s name/label value to the 63-char cap, keeping it
+    unique via a short hash of the full string."""
+    if len(base) <= limit:
+        return base
+    import hashlib
+
+    digest = hashlib.sha1(base.encode()).hexdigest()[:8]
+    return base[: limit - 9].rstrip("-") + "-" + digest
+
+
 def _parse_custom_envs(raw: str) -> List[dict]:
     if not raw:
         return []
@@ -383,7 +396,7 @@ def generate_workflow_docs(
     server_workers: int = 2,
     ml_server_hpa_type: str = "cpu",
     ml_server_max_replicas: Optional[int] = None,
-    ml_server_min_replicas: int = 1,
+    ml_server_min_replicas: Optional[int] = None,
     ml_server_hpa_cpu_target: int = 50,
     prometheus_server_address: str = "http://prometheus:9090",
     keda_threshold: str = "10",
@@ -505,6 +518,18 @@ def generate_workflow_docs(
                     "id": chunk_id,
                     "machine_names": [m.name for m in chunk],
                     "n_machines": len(chunk),
+                    # revision-scoped + 63-char-bounded: chunk ids repeat
+                    # across revisions (g0c0, ...), so an unscoped selector
+                    # could resolve to a prior revision's still-terminating
+                    # coordinator pod during rollover; and long project
+                    # names would push the Service name past the k8s cap
+                    "label": _bounded_k8s_name(
+                        f"{project_name}-r{project_revision}-{chunk_id}"
+                    ),
+                    "coord_name": _bounded_k8s_name(
+                        f"gordo-coord-{project_name}-"
+                        f"r{project_revision}-{chunk_id}"
+                    ),
                 }
             )
             for m in chunk:
@@ -521,9 +546,17 @@ def generate_workflow_docs(
             f"/gordo/config/{project_name}/{project_revision}/"
             f"group-{group_idx}.yaml"
         )
+        expected_models_path = (
+            f"/gordo/config/{project_name}/{project_revision}/"
+            f"expected-models.json"
+        )
 
         context = {
             "project_name": project_name,
+            # the whole PROJECT's machine list (not this split-workflow
+            # group's): the server's EXPECTED_MODELS/readiness gate must be
+            # identical in every doc
+            "all_machine_names": [m.name for m in norm.machines],
             "project_revision": project_revision,
             "project_version": __version__,
             "labels": dict(resource_labels),
@@ -534,6 +567,7 @@ def generate_workflow_docs(
             "builder_chunks": builder_chunks,
             "group_config": group_config,
             "staged_config_path": staged_config_path,
+            "expected_models_path": expected_models_path,
             "machines": machine_ctx,
             "enable_clients": enable_clients,
             "enable_influx": enable_influx,
@@ -560,7 +594,13 @@ def generate_workflow_docs(
             "server_workers": server_workers,
             "ml_server_hpa": {
                 "type": ml_server_hpa_type,
-                "min_replicas": ml_server_min_replicas,
+                # --server-replicas feeds the floor (the Deployment pins
+                # no replica count; the autoscaler owns scaling)
+                "min_replicas": (
+                    ml_server_min_replicas
+                    if ml_server_min_replicas is not None
+                    else server_replicas
+                ),
                 "max_replicas": max_replicas,
                 "cpu_target": ml_server_hpa_cpu_target,
                 "cooldown": 300,

@@ -202,6 +202,17 @@ def openapi_document() -> dict:
                 "get": {"summary": "Liveness probe",
                         "responses": {"200": {"description": "OK"}}}
             },
+            "/readiness": {
+                "get": {
+                    "summary": "Readiness probe: 200 iff every "
+                    "EXPECTED_MODELS artifact is present",
+                    "responses": {
+                        "200": {"description": "All expected models present"},
+                        "503": {"description": "Build still in progress "
+                                "(body lists missing models)"},
+                    },
+                }
+            },
             "/server-version": {
                 "get": {"summary": "Server version",
                         "responses": {"200": {"description": "{version}"}}}

@@ -36,6 +36,7 @@ def default_config() -> Dict[str, Any]:
     return {
         "MODEL_COLLECTION_DIR": os.environ.get("MODEL_COLLECTION_DIR"),
         "EXPECTED_MODELS": json.loads(expected_models) if expected_models else [],
+        "EXPECTED_MODELS_FILE": os.environ.get("EXPECTED_MODELS_FILE"),
         "ENABLE_PROMETHEUS": os.environ.get("ENABLE_PROMETHEUS", "false").lower()
         in ("1", "true", "yes"),
         "PROJECT": os.environ.get("PROJECT"),
@@ -57,6 +58,7 @@ class GordoServer:
     url_map = Map(
         [
             Rule("/healthcheck", endpoint="healthcheck"),
+            Rule("/readiness", endpoint="readiness"),
             Rule("/server-version", endpoint="server_version"),
             Rule("/metrics", endpoint="metrics"),
             Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
@@ -107,6 +109,7 @@ class GordoServer:
         if config:
             self.config.update(config)
         self.testing = False
+        self._ready_memo: set = set()
         self._prometheus = None
         if self.config["ENABLE_PROMETHEUS"]:
             from gordo_tpu.server.prometheus.metrics import (
@@ -150,6 +153,74 @@ class GordoServer:
             ctx.revision = ctx.current_revision
         return None
 
+    def expected_models(self):
+        """The project's expected machine list: the EXPECTED_MODELS env, or
+        the workflow-staged file (EXPECTED_MODELS_FILE — large fleets:
+        inlining 10k names into a Deployment env would blow k8s object-size
+        limits). The file is read per call, not at boot: stage-config may
+        write it after pod start. Raises OSError/ValueError when a declared
+        file is unreadable. Shared by /readiness and the
+        /expected-models route so the two can never disagree."""
+        expected = self.config.get("EXPECTED_MODELS") or []
+        expected_file = self.config.get("EXPECTED_MODELS_FILE")
+        if not expected and expected_file:
+            with open(expected_file) as fh:
+                expected = json.load(fh)
+        return expected
+
+    def _readiness_response(self, ctx: RequestContext) -> Response:
+        """200 iff every expected artifact is present in the collection dir
+        (503 otherwise; 200 when no expectation is set).
+
+        This is what makes revision rollover zero-downtime: the workflow
+        deploys the new revision's server at DAG start, but with a
+        readiness probe on this route plus maxUnavailable: 0, the previous
+        revision's pods keep serving until the new revision's models have
+        all been built.
+        """
+        # memoized once ready: artifacts of a revision are never un-built,
+        # and MODEL_COLLECTION_DIR is immutable per pod — without this,
+        # every kubelet probe would re-stat the whole fleet (10k models x
+        # every replica, forever) against the shared volume
+        memo_key = ctx.collection_dir
+        if memo_key in self._ready_memo:
+            return Response(
+                simplejson.dumps({"ready": True}), mimetype="application/json"
+            )
+        try:
+            expected = self.expected_models()
+        except (OSError, ValueError):
+            expected_file = self.config.get("EXPECTED_MODELS_FILE")
+            return Response(
+                simplejson.dumps(
+                    {"ready": False,
+                     "missing": [f"(expected-models file "
+                                 f"{expected_file!r} unreadable)"],
+                     "n_missing": 1}
+                ),
+                status=503,
+                mimetype="application/json",
+            )
+        missing = [
+            name for name in expected
+            if not os.path.exists(
+                os.path.join(ctx.collection_dir or "", name, "metadata.json")
+            )
+        ]
+        if missing:
+            return Response(
+                simplejson.dumps(
+                    {"ready": False, "missing": missing[:20],
+                     "n_missing": len(missing)}
+                ),
+                status=503,
+                mimetype="application/json",
+            )
+        self._ready_memo.add(memo_key)
+        return Response(
+            simplejson.dumps({"ready": True}), mimetype="application/json"
+        )
+
     def dispatch_request(self, request: Request) -> Response:
         ctx = RequestContext(self.config)
         adapter = self.url_map.bind_to_environ(request.environ)
@@ -183,6 +254,8 @@ class GordoServer:
             try:
                 if endpoint == "healthcheck":
                     response = Response("", status=200)
+                elif endpoint == "readiness":
+                    response = self._readiness_response(ctx)
                 elif endpoint == "server_version":
                     response = views.json_response(ctx, {"version": __version__})
                 elif endpoint == "openapi_spec":
@@ -199,6 +272,28 @@ class GordoServer:
                         response = Response(
                             self._prometheus.expose(),
                             mimetype="text/plain; version=0.0.4",
+                        )
+                elif endpoint == "expected_models":
+                    # the SAME resolution as /readiness (env or staged
+                    # file) — the two must never disagree about the fleet
+                    try:
+                        expected = self.expected_models()
+                    except (OSError, ValueError):
+                        # mirror /readiness: a declared-but-unreadable
+                        # expectation is an error, not an empty fleet
+                        expected = None
+                    if expected is None:
+                        response = Response(
+                            simplejson.dumps(
+                                {"error": "expected-models file declared "
+                                 "but unreadable"}
+                            ),
+                            status=503,
+                            mimetype="application/json",
+                        )
+                    else:
+                        response = views.json_response(
+                            ctx, {"expected-models": expected}
                         )
                 else:
                     handler = getattr(views, endpoint)

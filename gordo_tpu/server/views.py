@@ -268,5 +268,6 @@ def revision_list(ctx, request, gordo_project: str) -> Response:
     )
 
 
-def expected_models(ctx, request, gordo_project: str) -> Response:
-    return json_response(ctx, {"expected-models": ctx.config.get("EXPECTED_MODELS", [])})
+# /expected-models is handled inline in server.dispatch_request: it shares
+# the env-or-staged-file fleet resolution with /readiness (the two must
+# never disagree), which needs the GordoServer instance

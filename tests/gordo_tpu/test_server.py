@@ -468,3 +468,75 @@ def test_prometheus_labels_bounded_for_scanner_paths(model_collection_directory)
     assert 'path="/gordo/v0/<gordo_project>/<gordo_name>/prediction"' in body
     assert 'path="(unmatched)"' in body
     assert 'path="/healthcheck"' in body
+
+
+def test_readiness_gates_on_expected_models(
+    model_collection_directory, trained_model_directories, gordo_name,
+    second_gordo_name
+):
+    """/readiness is the zero-downtime rollover gate: 503 while any
+    EXPECTED_MODELS artifact is missing, 200 once the build completes."""
+    app = build_app(
+        {
+            "MODEL_COLLECTION_DIR": model_collection_directory,
+            "EXPECTED_MODELS": [gordo_name, second_gordo_name, "not-built"],
+        }
+    )
+    c = app.test_client()
+    resp = c.get("/readiness")
+    assert resp.status_code == 503
+    body = resp.get_json()
+    assert body["ready"] is False and body["missing"] == ["not-built"]
+
+    app = build_app(
+        {
+            "MODEL_COLLECTION_DIR": model_collection_directory,
+            "EXPECTED_MODELS": [gordo_name, second_gordo_name],
+        }
+    )
+    resp = app.test_client().get("/readiness")
+    assert resp.status_code == 200
+    assert resp.get_json()["ready"] is True
+
+    # no expectation set: ready (a manually-run server must come up)
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    assert app.test_client().get("/readiness").status_code == 200
+
+
+def test_readiness_file_based_expectation(
+    model_collection_directory, trained_model_directories, gordo_name,
+    second_gordo_name, tmp_path, monkeypatch
+):
+    """EXPECTED_MODELS_FILE (large fleets: the list lives on the shared
+    volume, not in a Deployment env) is read PER REQUEST — it may be
+    written after pod start — and a declared-but-unreadable expectation
+    means NOT ready."""
+    import json as _json
+
+    path = tmp_path / "expected-models.json"
+    monkeypatch.setenv("EXPECTED_MODELS_FILE", str(path))
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    c = app.test_client()
+    assert c.get("/readiness").status_code == 503  # declared, not yet staged
+
+    path.write_text(_json.dumps([gordo_name, "not-built"]))
+    assert c.get("/readiness").status_code == 503  # staged, build incomplete
+
+    path.write_text(_json.dumps([gordo_name, second_gordo_name]))
+    assert c.get("/readiness").status_code == 200  # same process, no restart
+
+
+def test_expected_models_endpoint_shares_file_resolution(
+    model_collection_directory, trained_model_directories, gordo_project,
+    tmp_path, monkeypatch
+):
+    """/expected-models and /readiness resolve the fleet the SAME way —
+    the staged-file mechanism must show up in both."""
+    import json as _json
+
+    path = tmp_path / "expected-models.json"
+    path.write_text(_json.dumps(["m-a", "m-b"]))
+    monkeypatch.setenv("EXPECTED_MODELS_FILE", str(path))
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    resp = app.test_client().get(f"/gordo/v0/{gordo_project}/expected-models")
+    assert resp.get_json()["expected-models"] == ["m-a", "m-b"]

@@ -284,7 +284,11 @@ def test_multihost_slice_rendering():
     }
     assert env["GORDO_TPU_NUM_PROCESSES"] == "2"
     assert env["GORDO_TPU_PROCESS_ID"] == "{{inputs.parameters.worker-id}}"
-    assert "gordo-coord-mh-proj-" in env["GORDO_TPU_COORDINATOR_ADDRESS"]
+    # the coordinator address is a runtime parameter; the DAG passes the
+    # generator-computed (revision-scoped, 63-char-bounded) name
+    assert env["GORDO_TPU_COORDINATOR_ADDRESS"] == (
+        "{{inputs.parameters.coord-name}}:8476"
+    )
 
     dag = templates["do-all"]["dag"]["tasks"]
     builders = [t for t in dag if t["template"] == "tpu-batch-builder"]
@@ -294,6 +298,14 @@ def test_multihost_slice_rendering():
     )
     coords = [t for t in dag if t["template"] == "gordo-coordinator-service"]
     assert len(coords) == len(builders)
+    for task in builders + coords:
+        params = {
+            p["name"]: p["value"] for p in task["arguments"]["parameters"]
+        }
+        assert params["coord-name"].startswith("gordo-coord-mh-proj-r1-")
+        assert len(params["coord-name"]) <= 63
+        assert params["chunk-label"].startswith("mh-proj-r1-")
+        assert len(params["chunk-label"]) <= 63
 
 
 def test_singlehost_has_no_coordinator():
@@ -544,3 +556,88 @@ def test_validator_steps_edge_cases():
     assert not any(
         "no template ref" in e for e in validate_workflow_doc(inline)
     )
+
+
+def test_long_project_names_bound_coordinator_names():
+    """A long project name must not push the per-chunk coordinator Service
+    name or pod label value past the k8s 63-char cap — the generator
+    truncates with a uniqueness hash. (Very long projects are bounded
+    earlier by the machine-host validator; 40 chars passes it and brings
+    the 'gordo-coord-' + revision + chunk-id concatenation to the edge.)"""
+    from gordo_tpu.cli.workflow_generator import _bounded_k8s_name
+
+    base = "gordo-coord-" + "a" * 60 + "-r1-g0c0"
+    bounded = _bounded_k8s_name(base)
+    assert len(bounded) <= 63
+    assert bounded != _bounded_k8s_name(base + "1")  # uniqueness preserved
+    assert _bounded_k8s_name("short") == "short"
+
+    long_name = "a" * 40
+    docs = generate_workflow_docs(
+        _config_yaml(2), project_name=long_name, tpu_workers_per_slice=2,
+        client_start_date="2019-01-01T00:00:00Z",
+        client_end_date="2019-01-02T00:00:00Z",
+    )
+    parsed = [d for d in yaml.safe_load_all(docs) if d]
+    dag = [
+        t for d in parsed for tpl in d["spec"]["templates"]
+        if tpl["name"] == "do-all" for t in tpl["dag"]["tasks"]
+    ]
+    seen = set()
+    for task in dag:
+        if task["template"] not in ("tpu-batch-builder", "gordo-coordinator-service"):
+            continue
+        params = {
+            p["name"]: p["value"] for p in task["arguments"]["parameters"]
+        }
+        assert len(params["coord-name"]) <= 63, params["coord-name"]
+        assert len(params["chunk-label"]) <= 63
+        seen.add(params["coord-name"])
+    assert seen  # bounded names stay unique per chunk
+
+
+def test_server_rollout_gated_on_full_project_readiness():
+    """Zero-downtime rollover: every split-workflow doc deploys the same
+    server manifest — EXPECTED_MODELS lists the WHOLE project's machines,
+    the readiness probe hits /readiness, and maxUnavailable: 0 keeps the
+    previous revision serving until the new build completes."""
+    docs = generate_workflow_docs(
+        _config_yaml(35), project_name="ro-proj", split_workflows=30,
+        client_start_date="2019-01-01T00:00:00Z",
+        client_end_date="2019-01-02T00:00:00Z",
+    )
+    parsed = [d for d in yaml.safe_load_all(docs) if d]
+    assert len(parsed) == 2
+    manifests = []
+    for doc in parsed:
+        for tpl in doc["spec"]["templates"]:
+            if tpl["name"] == "gordo-server-deployment":
+                manifests.append(yaml.safe_load(tpl["resource"]["manifest"]))
+    assert len(manifests) == 2
+    for dep in manifests:
+        spec = dep["spec"]
+        assert "replicas" not in spec  # the autoscaler owns scaling
+        assert spec["strategy"]["rollingUpdate"]["maxUnavailable"] == 0
+        container = spec["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        # file-based (inlining 10k names would blow k8s object limits);
+        # stage-config writes the WHOLE project's list to this path
+        assert env["EXPECTED_MODELS_FILE"].endswith("expected-models.json")
+        assert container["readinessProbe"]["httpGet"]["path"] == "/readiness"
+    # identical across docs: whichever doc applies last changes nothing
+    assert manifests[0] == manifests[1]
+    # and stage-config writes the full 35-machine expectation in BOTH docs
+    import json as _json
+
+    for doc in parsed:
+        stage = next(
+            t for t in doc["spec"]["templates"] if t["name"] == "stage-config"
+        )
+        body = stage["script"]["source"]
+        marker_end = body.index("GORDO_TPU_EXPECTED_EOF") + len(
+            "GORDO_TPU_EXPECTED_EOF"
+        )
+        start = body.index("\n", marker_end) + 1
+        end = body.index("GORDO_TPU_EXPECTED_EOF", start)
+        expected = _json.loads(body[start:end].strip())
+        assert len(expected) == 35
