@@ -31,7 +31,13 @@ smoke:
 		--client-end-date 2019-01-02T00:00:00Z \
 		| python -m gordo_tpu.cli workflow validate -
 
+# every Jinja branch of the workflow template rendered + linted; run after
+# ANY edit under gordo_tpu/workflow/resources/ (round-4 postmortem: a
+# template edit shipped unrendered and killed `workflow generate`)
+render-gate:
+	python -m pytest tests/gordo_tpu/test_workflow_template_render.py -q
+
 bench:
 	python bench.py
 
-.PHONY: image push test dryrun smoke bench
+.PHONY: image push test dryrun smoke render-gate bench

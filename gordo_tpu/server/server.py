@@ -43,6 +43,66 @@ def default_config() -> Dict[str, Any]:
     }
 
 
+def adapt_proxy_deployment(wsgi_app):
+    """WSGI middleware for prefixed-ingress deployments — Envoy/Ambassador
+    path prefixes and Istio VirtualService prefix routing (the deployment
+    topology the workflow template generates).
+
+    Reference parity: gordo/server/server.py:46-119. When the ingress
+    strips a route prefix before forwarding, the app sees only the local
+    path; the original full path arrives in ``X-Envoy-Original-Path``
+    (Envoy/Ambassador), or the stripped prefix alone in
+    ``X-Forwarded-Prefix`` (the generic ingress convention). Rewrites
+    ``SCRIPT_NAME``/``PATH_INFO`` so werkzeug's router matches the local
+    route and generated URLs carry the external prefix, and honours
+    ``X-Forwarded-Proto`` for the scheme.
+    """
+    from functools import wraps
+
+    def _localize(environ, prefix: str):
+        """Strip ``prefix`` off PATH_INFO at a path-segment boundary only:
+        '/svc' must localize '/svc/metadata' but never '/svc2/metadata',
+        and the result keeps its leading slash (PEP 3333)."""
+        path_info = environ.get("PATH_INFO", "")
+        if path_info == prefix:
+            environ["PATH_INFO"] = "/"
+        elif path_info.startswith(prefix + "/"):
+            environ["PATH_INFO"] = path_info[len(prefix):]
+
+    @wraps(wsgi_app)
+    def wrapper(environ, start_response):
+        path_info = environ.get("PATH_INFO", "")
+        # Envoy's header carries the original :path INCLUDING any query
+        # string — only the path part participates in prefix derivation
+        original = environ.get(
+            "HTTP_X_ENVOY_ORIGINAL_PATH", ""
+        ).split("?", 1)[0]
+        if original:
+            local = path_info.rstrip("/")
+            if local and original.endswith(local):
+                # the prefix is the full original path minus the local path
+                prefix = original[: -len(local)]
+            else:
+                # header names the prefix itself (or PATH_INFO already IS
+                # the full external path, which _localize then strips)
+                prefix = original
+            prefix = prefix.rstrip("/")
+            environ["SCRIPT_NAME"] = prefix
+            if prefix:
+                _localize(environ, prefix)
+        else:
+            prefix = environ.get("HTTP_X_FORWARDED_PREFIX", "").rstrip("/")
+            if prefix:
+                environ["SCRIPT_NAME"] = prefix
+                _localize(environ, prefix)
+        scheme = environ.get("HTTP_X_FORWARDED_PROTO", "")
+        if scheme:
+            environ["wsgi.url_scheme"] = scheme
+        return wsgi_app(environ, start_response)
+
+    return wrapper
+
+
 class RequestContext:
     """Per-request state (the no-flask equivalent of flask.g)."""
 
@@ -337,8 +397,13 @@ class GordoServer:
 def build_app(
     config: Optional[Dict[str, Any]] = None, prometheus_registry=None
 ) -> GordoServer:
-    """Build the WSGI app (reference build_app, server.py:139-231)."""
-    return GordoServer(config, prometheus_registry=prometheus_registry)
+    """Build the WSGI app (reference build_app, server.py:139-231; the
+    proxy adaptation mirrors its :156)."""
+    app = GordoServer(config, prometheus_registry=prometheus_registry)
+    # instance attribute shadows the bound method, exactly like the
+    # reference's ``app.wsgi_app = adapt_proxy_deployment(app.wsgi_app)``
+    app.wsgi_app = adapt_proxy_deployment(app.wsgi_app)
+    return app
 
 
 def run_server(

@@ -5,21 +5,58 @@ re-use) the same cache."""
 import os
 
 
+def host_fingerprint() -> str:
+    """Short stable hash of everything that makes an XLA:CPU AOT artifact
+    host-specific: machine arch, CPU feature flags, and the jaxlib version.
+
+    Partitioning the persistent cache by platform tag alone is not enough:
+    XLA:CPU AOT executables bake in the compile host's CPU features, and
+    loading one on a host with different features warns ("could lead to
+    execution errors such as SIGILL") and can crash. TPU executables don't
+    depend on host CPU features, but including the fingerprint there too
+    only costs a cold cache after a host change — never a bad artifact.
+    """
+    import hashlib
+    import platform
+
+    parts = [platform.machine(), platform.processor() or ""]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                # x86 "flags", arm64 "Features" — the first such line is the
+                # full feature set AOT code generation keys on
+                if line.startswith(("flags", "Features")):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    try:
+        import jaxlib
+
+        parts.append(getattr(jaxlib, "__version__", ""))
+    except Exception:  # noqa: BLE001
+        pass
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
+
+
 def setup_persistent_xla_cache(min_compile_secs: float = 1.0) -> str:
-    """Point jax at the platform-partitioned persistent compile cache.
+    """Point jax at the platform+host-partitioned persistent compile cache.
 
     Via ``jax.config``, not env: jax reads ``JAX_COMPILATION_CACHE_DIR`` at
-    import, long before callers run. Partitioned by platform tag — a
-    remote-compiled TPU artifact must never be offered to a CPU-fallback
-    process on a host with different machine features. Failures are
-    swallowed (the cache is an optimization only). Returns the dir used.
+    import, long before callers run. Partitioned by platform tag AND a host
+    fingerprint (arch + CPU flags + jaxlib version): a remote-compiled
+    artifact must never be offered to a process on a host with different
+    machine features (the round-4 bench drowned in XLA:CPU AOT
+    feature-mismatch warnings from exactly that). Failures are swallowed
+    (the cache is an optimization only). Returns the dir used.
     """
     import jax
 
     cache_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
         "/tmp/gordo_tpu_xla_cache-"
-        + (os.environ.get("JAX_PLATFORMS") or "default"),
+        + (os.environ.get("JAX_PLATFORMS") or "default")
+        + "-" + host_fingerprint(),
     )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -540,3 +540,143 @@ def test_expected_models_endpoint_shares_file_resolution(
     app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
     resp = app.test_client().get(f"/gordo/v0/{gordo_project}/expected-models")
     assert resp.get_json()["expected-models"] == ["m-a", "m-b"]
+
+
+# ------------------------------------------------------- proxy adaptation
+# Reference parity: gordo/server/server.py:46-119 (adapt_proxy_deployment) —
+# the server must work behind a prefixed ingress (Envoy/Ambassador, Istio
+# VirtualService prefix routing, the topology the workflow template deploys).
+
+
+def test_proxy_envoy_stripped_prefix(client):
+    """Ingress stripped the prefix: PATH_INFO is local, the original full
+    path rides X-Envoy-Original-Path. Routing must still hit the route."""
+    resp = client.get(
+        "/healthcheck",
+        headers={"X-Envoy-Original-Path": "/gordo/v0/proj/tgt/healthcheck"},
+    )
+    assert resp.status_code == 200
+
+
+def test_proxy_envoy_full_path_forwarded(client, gordo_project, gordo_name):
+    """Proxy forwarded the FULL external path as PATH_INFO: the adapter must
+    localize it (strip the prefix it derives from the Envoy header) or the
+    absolute route table 404s."""
+    local = f"/gordo/v0/{gordo_project}/{gordo_name}/metadata"
+    resp = client.get(
+        f"/prefixed/ingress{local}",
+        headers={"X-Envoy-Original-Path": "/prefixed/ingress"},
+    )
+    assert resp.status_code == 200
+    assert resp.get_json()["metadata"]["name"] == gordo_name
+
+
+def test_proxy_forwarded_prefix(client, gordo_project):
+    """Generic ingress convention: X-Forwarded-Prefix names the stripped
+    prefix; a full-path PATH_INFO must be localized against it."""
+    resp = client.get(
+        f"/svc/gordo/v0/{gordo_project}/models",
+        headers={"X-Forwarded-Prefix": "/svc"},
+    )
+    assert resp.status_code == 200
+    assert "models" in resp.get_json()
+
+
+def test_proxy_no_headers_prefixed_path_404s(client):
+    """Without proxy headers a prefixed path must NOT silently match."""
+    assert client.get("/some/prefix/healthcheck").status_code == 404
+
+
+def test_proxy_sets_script_name_and_scheme():
+    """The middleware rewrites SCRIPT_NAME/PATH_INFO/url_scheme exactly."""
+    from gordo_tpu.server.server import adapt_proxy_deployment
+
+    seen = {}
+
+    def inner(environ, start_response):
+        seen.update(environ)
+        start_response("200 OK", [("Content-Type", "text/plain")])
+        return [b"ok"]
+
+    wrapped = adapt_proxy_deployment(inner)
+    environ = {
+        "PATH_INFO": "/svc/metadata",
+        "HTTP_X_FORWARDED_PREFIX": "/svc/",
+        "HTTP_X_FORWARDED_PROTO": "https",
+        "wsgi.url_scheme": "http",
+    }
+    assert wrapped(environ, lambda *a: None) == [b"ok"]
+    assert seen["SCRIPT_NAME"] == "/svc"
+    assert seen["PATH_INFO"] == "/metadata"
+    assert seen["wsgi.url_scheme"] == "https"
+
+
+def test_proxy_envoy_prefix_suffix_strip_not_substring():
+    """The prefix is ORIGINAL_PATH minus the PATH_INFO *suffix* — a local
+    path that also appears mid-prefix must not be clipped out of the middle
+    (the reference's str.replace would)."""
+    from gordo_tpu.server.server import adapt_proxy_deployment
+
+    seen = {}
+
+    def inner(environ, start_response):
+        seen.update(environ)
+        return []
+
+    environ = {
+        "PATH_INFO": "/metrics",
+        "HTTP_X_ENVOY_ORIGINAL_PATH": "/metrics/service/metrics",
+    }
+    adapt_proxy_deployment(inner)(environ, lambda *a: None)
+    assert seen["SCRIPT_NAME"] == "/metrics/service"
+    assert seen["PATH_INFO"] == "/metrics"
+
+
+def test_proxy_envoy_header_query_string_ignored():
+    """Envoy's header carries the original :path INCLUDING the query
+    string; only the path part may join prefix derivation."""
+    from gordo_tpu.server.server import adapt_proxy_deployment
+
+    seen = {}
+
+    def inner(environ, start_response):
+        seen.update(environ)
+        return []
+
+    environ = {
+        "PATH_INFO": "/prediction",
+        "QUERY_STRING": "format=csv",
+        "HTTP_X_ENVOY_ORIGINAL_PATH": "/svc/prediction?format=csv",
+    }
+    adapt_proxy_deployment(inner)(environ, lambda *a: None)
+    assert seen["SCRIPT_NAME"] == "/svc"
+    assert seen["PATH_INFO"] == "/prediction"
+
+
+def test_proxy_prefix_boundary_not_false_match():
+    """'/svc' must not localize '/svc2/metadata' (segment boundary), and a
+    stripped path keeps its leading slash (PEP 3333)."""
+    from gordo_tpu.server.server import adapt_proxy_deployment
+
+    seen = {}
+
+    def inner(environ, start_response):
+        seen.update(environ)
+        return []
+
+    wrapped = adapt_proxy_deployment(inner)
+    environ = {
+        "PATH_INFO": "/svc2/metadata",
+        "HTTP_X_FORWARDED_PREFIX": "/svc",
+    }
+    wrapped(environ, lambda *a: None)
+    assert seen["PATH_INFO"] == "/svc2/metadata"  # unchanged
+
+    seen.clear()
+    environ = {
+        "PATH_INFO": "/svc/metadata",
+        "HTTP_X_ENVOY_ORIGINAL_PATH": "/svc/",
+    }
+    wrapped(environ, lambda *a: None)
+    assert seen["PATH_INFO"] == "/metadata"
+    assert seen["SCRIPT_NAME"] == "/svc"

@@ -1,0 +1,39 @@
+"""Persistent XLA compile-cache keying (round-4 verdict item 3): the cache
+dir must be partitioned by host machine features, not just platform tag, so
+AOT artifacts from another host are never offered to this one."""
+
+import os
+from unittest import mock
+
+import jax
+import pytest
+
+from gordo_tpu.util.xla_cache import host_fingerprint, setup_persistent_xla_cache
+
+
+@pytest.fixture(autouse=True)
+def _restore_jax_cache_config():
+    prior = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", prior)
+
+
+def test_fingerprint_stable_and_short():
+    a, b = host_fingerprint(), host_fingerprint()
+    assert a == b
+    assert len(a) == 12
+    int(a, 16)  # hex
+
+
+def test_cache_dir_includes_platform_and_fingerprint():
+    with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "cpu"}, clear=False):
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        cache_dir = setup_persistent_xla_cache()
+    assert cache_dir == f"/tmp/gordo_tpu_xla_cache-cpu-{host_fingerprint()}"
+
+
+def test_explicit_env_dir_wins():
+    with mock.patch.dict(
+        os.environ, {"JAX_COMPILATION_CACHE_DIR": "/tmp/explicit-cache"}
+    ):
+        assert setup_persistent_xla_cache() == "/tmp/explicit-cache"
