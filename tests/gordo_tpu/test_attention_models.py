@@ -357,3 +357,34 @@ def test_causal_conv_matmul_form_matches_conv_general_dilated():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
+
+
+def test_causal_conv1d_matches_lax_conv_and_short_windows():
+    """The no-pad post-shift causal conv (one clean GEMM + fused shifted
+    adds; round-5 CPU fast-path rework) must match XLA's own dilated conv
+    bit-for-bit in f32, including sequences SHORTER than the receptive
+    field (taps whose whole output precedes the series start contribute
+    zero)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gordo_tpu.ops.nn import _causal_conv1d
+
+    rng = np.random.RandomState(7)
+    for t, dilation in [(144, 1), (144, 8), (16, 8), (3, 2), (1, 4)]:
+        x = jnp.asarray(rng.standard_normal((2, t, 5)), jnp.float32)
+        kernel = jnp.asarray(rng.standard_normal((3, 5, 4)), jnp.float32)
+        got = _causal_conv1d(x, kernel, dilation)
+        ref = jax.lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=(1,),
+            padding=[((kernel.shape[0] - 1) * dilation, 0)],
+            rhs_dilation=(dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5,
+            err_msg=f"t={t} dilation={dilation}",
+        )

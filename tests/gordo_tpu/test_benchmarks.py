@@ -115,3 +115,99 @@ def test_bench_backend_probe_require_accel(monkeypatch):
     monkeypatch.setenv("PYTHONPATH", "")
     assert bench._default_backend_alive(120) is True
     assert bench._default_backend_alive(120, require_accel=True) is False
+
+
+def test_bench_section_timeout_partial_recovery(monkeypatch):
+    """A section child killed on its leash must not lose the phases it
+    already printed: the parent recovers the LAST partial envelope from the
+    captured stdout and marks it hung+partial (round-5: windowed families
+    and headline phases emit partials as they complete)."""
+    import subprocess
+
+    import bench
+
+    partial1 = json.dumps({"platform": "cpu", "result": {"fam_a": {"x": 1}}})
+    partial2 = json.dumps(
+        {"platform": "cpu", "result": {"fam_a": {"x": 1}, "fam_b": {"x": 2}}}
+    )
+    stdout = f"noise\n{partial1}\n{partial2}\nnot json".encode()
+
+    def fake_run(*args, **kwargs):
+        raise subprocess.TimeoutExpired(
+            cmd="x", timeout=7, output=stdout, stderr=b"stderr tail"
+        )
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    entry = bench._run_section("windowed", timeout=7)
+    assert entry["hung"] and entry["partial"]
+    assert entry["platform"] == "cpu"
+    assert entry["result"] == {"fam_a": {"x": 1}, "fam_b": {"x": 2}}
+    # still wedge-shaped, so the recovery pass can upgrade it...
+    assert bench._wedge_degraded(entry)
+    # ...and a COMPLETE rerun beats the partial (it carries "error")
+    assert bench._rerun_improves(
+        {"platform": "cpu", "result": {"done": 1}}, entry
+    )
+
+
+def test_bench_section_timeout_no_partials(monkeypatch):
+    """Timeout with no parseable partial still returns the plain hang
+    entry."""
+    import subprocess
+
+    import bench
+
+    def fake_run(*args, **kwargs):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=7, output=b"garbage")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    entry = bench._run_section("headline", timeout=7)
+    assert entry["hung"] and "partial" not in entry and "result" not in entry
+
+
+def test_bench_emit_record_partial_sections(capsys, tmp_path, monkeypatch):
+    """Incremental emission: the compact line renders at every stage of
+    completeness — empty sections, smoke-only (serving falls back to the
+    smoke's mini measurement), and budget-skipped sections listed."""
+    import bench
+
+    monkeypatch.setenv("BENCH_DETAIL_FILE", str(tmp_path / "detail.json"))
+    sections = {n: {} for n in ("tpu_smoke", "headline", "windowed",
+                                "batch_ab")}
+    bench._emit_record(sections, [])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] is None
+
+    sections["tpu_smoke"] = {
+        "platform": "tpu",
+        "result": {"flash": {"ok": True}, "bf16_fleet": {"ok": True},
+                   "serving": {"p50_ms": 3.0, "samples_per_sec": 100.0}},
+    }
+    sections["windowed"] = {"skipped_for_budget": True, "remaining_sec": 10}
+    bench._emit_record(sections, [])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["serving_source"] == "tpu_smoke"
+    assert line["server_p50_anomaly_ms"] == 3.0
+    assert line["tpu_smoke"]["flash_ok"] is True
+    assert line["skipped_for_budget"] == ["windowed"]
+    assert len(json.dumps(line)) < 1024 * 2
+
+
+def test_bench_section_crash_partial_recovery(monkeypatch):
+    """A child that dies with a non-zero exit (OOM kill) keeps its printed
+    partials too — not just the timeout path."""
+    import subprocess
+
+    import bench
+
+    partial = json.dumps({"platform": "tpu", "result": {"fam_a": {"x": 1}}})
+
+    class Proc:
+        returncode = -9
+        stdout = f"{partial}\n"
+        stderr = "killed"
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: Proc())
+    entry = bench._run_section("windowed", timeout=7)
+    assert entry["partial"] and entry["result"] == {"fam_a": {"x": 1}}
+    assert "error" in entry
