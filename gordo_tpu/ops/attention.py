@@ -147,13 +147,25 @@ def _flash_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     """
     Whether the Pallas flash kernel supports these shapes on this backend.
     The kernel needs self-attention (equal Q/K lengths), T divisible by its
-    128-row blocks, and a lane-friendly head dim; below ~256 rows the O(T²)
-    XLA path is already VMEM-resident and the kernel buys nothing.
+    128-row blocks, and a FULL-lane head dim: dh >= 64 — Mosaic lowering of
+    sub-64 head dims was measured to hang (a dh=8 TPU export ran >300 s
+    without completing), and small heads waste most of the 128-lane vector
+    unit anyway, so they stay on the XLA path. Below ~256 rows the O(T²)
+    XLA path is already VMEM-resident and the kernel buys nothing; above
+    ~4096 rows the kernel's full-length K/V (and lane-replicated lse)
+    VMEM staging approaches the ~16 MB budget — longer sequences belong to
+    ring attention (parallel/ring_attention.py), the designed long-T path.
     """
     if jax.default_backend() != "tpu":
         return False
     t, dh = q.shape[-2], q.shape[-1]
-    return k.shape[-2] == t and t >= 256 and t % 128 == 0 and dh % 8 == 0
+    return (
+        k.shape[-2] == t
+        and 256 <= t <= 4096
+        and t % 128 == 0
+        and dh % 8 == 0
+        and dh >= 64
+    )
 
 
 def dot_product_attention(

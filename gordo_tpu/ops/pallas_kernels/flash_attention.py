@@ -32,6 +32,10 @@ NEG_INF = -1e30
 
 BLOCK_Q = 128
 BLOCK_K = 128
+# trailing lane-replication axis for per-row statistics (lse, delta): TPU
+# vector blocks need their last dim 128-tileable, so row vectors are
+# stored broadcast across 128 lanes and sliced back to one lane on read
+LANES = 128
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
@@ -72,16 +76,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         n_kb = jnp.minimum(n_kb, ((qi + 1) * block_q + block_k - 1) // block_k)
     m_fin, l_fin, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
-    lse_ref[0] = (m_fin + jnp.log(jnp.maximum(l_fin, 1e-30)))[:, 0]
+    # lse is REPLICATED across the LANES axis (its block's trailing dim):
+    # Mosaic requires the last two block dims be (8, 128)-tileable, so a
+    # flat (1, block_q) row vector cannot be a TPU output block. The
+    # standard trick (same as jax's own TPU flash kernel) is an extra
+    # 128-lane axis carrying the broadcast value; readers slice lane 0.
+    lse_ref[0] = jnp.broadcast_to(
+        m_fin + jnp.log(jnp.maximum(l_fin, 1e-30)), (block_q, LANES)
+    )
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, dq_ref,
                      *, scale: float, causal: bool, block_k: int):
     """dQ for one query block: loop over key blocks, recomputing P from lse."""
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]       # (BLOCK_Q, 1)
-    delta = delta_ref[0][:, None]   # (BLOCK_Q, 1)
+    lse = lse_ref[0][:, :1]         # (BLOCK_Q, 1) — lane 0 of the broadcast
+    # D = rowsum(dO ∘ O), recomputed from the already-staged blocks: a
+    # VPU-trivial reduction that avoids materializing a lane-broadcast
+    # delta tensor in HBM and staging it in VMEM (review finding)
+    delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                    keepdims=True)
     block_q, dh = q.shape
     t_k = k_ref.shape[1]
     n_kb = t_k // block_k
@@ -109,7 +124,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = jax.lax.fori_loop(0, n_kb, body, dq0).astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                       dk_ref, dv_ref, *, scale: float, causal: bool,
                       block_q: int):
     """dK/dV for one key block: loop over query blocks."""
@@ -125,8 +140,12 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :1]
+        # recomputed per q-block from the staged dO/O (see _flash_dq_kernel)
+        delta = jnp.sum(
+            do * o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
         s = (q @ k_blk.T) * scale          # (BLOCK_Q, BLOCK_K)
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
@@ -184,11 +203,11 @@ def _flash_forward(q, k, v, causal: bool, interpret: bool):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -199,11 +218,8 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
     bh, t, dh = q.shape
     block_q, block_k = _block_sizes(t)
     scale = 1.0 / (dh**0.5)
-    # D_i = rowsum(dO ∘ O): tiny (BH, T) tensor, cheapest outside the kernels
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     full = lambda b, i: (b, 0, 0)
-    rows = lambda b, i: (b, 0)
     dq_kernel = functools.partial(
         _flash_dq_kernel, scale=scale, causal=causal, block_k=block_k
     )
@@ -215,13 +231,13 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
             pl.BlockSpec((1, t, dh), full),
             pl.BlockSpec((1, t, dh), full),
             pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, o)
 
     dkv_kernel = functools.partial(
         _flash_dkv_kernel, scale=scale, causal=causal, block_q=block_q
@@ -234,8 +250,8 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
             pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, t, dh), full),
-            pl.BlockSpec((1, t), rows),
-            pl.BlockSpec((1, t), rows),
+            pl.BlockSpec((1, t, LANES), full),
+            pl.BlockSpec((1, t, dh), full),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, dh), lambda b, j: (b, j, 0)),
@@ -246,7 +262,7 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, o)
     return dq, dk, dv
 
 

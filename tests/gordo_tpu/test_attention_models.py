@@ -388,3 +388,58 @@ def test_causal_conv1d_matches_lax_conv_and_short_windows():
             np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5,
             err_msg=f"t={t} dilation={dilation}",
         )
+
+
+def test_flash_attention_lowers_through_mosaic_for_tpu():
+    """The interpret-mode tests above prove the kernel's MATH; this proves
+    its TILING. jax.export with platforms=["tpu"] runs the real Mosaic
+    lowering on a CPU host — which round 5 found rejecting the kernel
+    outright (the flat (1, block_q) lse output block violates the (8, 128)
+    tile rule; lse/delta are now lane-replicated). Any future block-spec
+    edit that breaks TPU lowering fails here, in CI, without a TPU."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export
+
+    q = jnp.zeros((2, 4, 512, 64), jnp.float32)
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False)
+
+    def grads(q, k, v):
+        return jax.grad(
+            lambda a, b, c: jnp.sum(fwd(a, b, c) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    fwd_mlir = export.export(jax.jit(fwd), platforms=["tpu"])(
+        q, q, q
+    ).mlir_module()
+    assert fwd_mlir.count("tpu_custom_call") == 1
+    bwd_mlir = export.export(jax.jit(grads), platforms=["tpu"])(
+        q, q, q
+    ).mlir_module()
+    # fwd kernel + dq kernel + fused dk/dv kernel
+    assert bwd_mlir.count("tpu_custom_call") == 3
+
+
+def test_flash_dispatch_gate_matches_lowering_support(monkeypatch):
+    """_flash_ok must only admit shapes the Mosaic lowering handles: dh<64
+    was measured to hang TPU lowering, and t>4096 approaches the VMEM
+    budget (long sequences are ring attention's job)."""
+    import jax.numpy as jnp
+
+    from gordo_tpu.ops import attention
+
+    monkeypatch.setattr(
+        attention.jax, "default_backend", lambda: "tpu"
+    )
+
+    def ok(t, dh):
+        x = jnp.zeros((1, 2, t, dh), jnp.float32)
+        return attention._flash_ok(x, x)
+
+    assert ok(512, 64) and ok(4096, 128)
+    assert not ok(512, 8)      # sub-64 head dim: lowering hang
+    assert not ok(512, 16)
+    assert not ok(8192, 64)    # past the VMEM-budget cap
+    assert not ok(128, 64)     # below the win threshold
