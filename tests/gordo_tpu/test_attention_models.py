@@ -421,6 +421,15 @@ def test_flash_attention_lowers_through_mosaic_for_tpu():
     # fwd kernel + dq kernel + fused dk/dv kernel
     assert bwd_mlir.count("tpu_custom_call") == 3
 
+    # bfloat16 — the windowed fleets' TPU compute dtype — has DIFFERENT
+    # minimum tiles ((16, 128) vs f32's (8, 128)), so its lowering is a
+    # separate thing to prove
+    qb = jnp.zeros((2, 4, 512, 64), jnp.bfloat16)
+    bf16_mlir = export.export(jax.jit(grads), platforms=["tpu"])(
+        qb, qb, qb
+    ).mlir_module()
+    assert bf16_mlir.count("tpu_custom_call") == 3
+
 
 def test_flash_dispatch_gate_matches_lowering_support(monkeypatch):
     """_flash_ok must only admit shapes the Mosaic lowering handles: dh<64
@@ -443,3 +452,25 @@ def test_flash_dispatch_gate_matches_lowering_support(monkeypatch):
     assert not ok(512, 16)
     assert not ok(8192, 64)    # past the VMEM-budget cap
     assert not ok(128, 64)     # below the win threshold
+
+
+def test_flash_attention_bfloat16_matches_reference():
+    """bf16 inputs with f32 accumulators: within bf16 tolerance of the XLA
+    reference (the windowed fleets' TPU compute dtype)."""
+    rng = np.random.RandomState(3)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 2, 256, 32)), jnp.float32).astype(
+            jnp.bfloat16
+        )
+        for _ in range(3)
+    )
+    raw = flash_attention(q, k, v, causal=True, interpret=True)
+    # output stays at the input dtype; accumulation is f32 inside
+    assert raw.dtype == jnp.bfloat16
+    ref = dot_product_attention_xla(q, k, v, causal=True).astype(jnp.float32)
+    got = raw.astype(jnp.float32)
+    assert ref.shape == got.shape
+    rel = float(
+        jnp.max(jnp.abs(ref - got)) / (jnp.max(jnp.abs(ref)) + 1e-9)
+    )
+    assert rel < 2e-2, rel
