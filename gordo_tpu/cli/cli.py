@@ -16,6 +16,7 @@ injection is explicit: set ``GORDO_TPU_FAULT_INJECTION=<ExceptionName>`` to
 raise after a successful build (used to exercise exit-code plumbing e2e).
 """
 
+import json
 import logging
 import os
 import sys
@@ -32,6 +33,11 @@ from gordo_tpu.dataset.datasets import InsufficientDataError
 from gordo_tpu.dataset.sensor_tag import SensorTagNormalizationError
 from gordo_tpu.machine import Machine
 from gordo_tpu.reporters.base import ReporterException
+from gordo_tpu.util.faults import (
+    EXIT_NONE_BUILT,
+    EXIT_PARTIAL,
+    NonFiniteDataError,
+)
 from .custom_types import HostIP, key_value_par
 from .exceptions_reporter import ExceptionsReporter, ReportLevel
 
@@ -44,6 +50,7 @@ _exceptions_reporter = ExceptionsReporter(
         (FileNotFoundError, 30),
         (SensorTagNormalizationError, 60),
         (InsufficientDataError, 80),
+        (NonFiniteDataError, 83),
         (ReporterException, 90),
     )
 )
@@ -279,6 +286,22 @@ def _report_exception_and_exit(
     "their chunk finishes and an interrupted fleet build resumes from "
     "cache instead of retraining",
 )
+@click.option(
+    "--fail-fast",
+    is_flag=True,
+    default=False,
+    envvar="GORDO_TPU_FAIL_FAST",
+    help="Abort the whole fleet build on the first fault instead of "
+    "quarantining the affected machine and degrading machine-by-machine "
+    "(restores pre-fault-domain behavior; see docs/robustness.md)",
+)
+@click.option(
+    "--quarantine-report-file",
+    default=None,
+    envvar="GORDO_TPU_QUARANTINE_REPORT_FILE",
+    help="Write quarantined machines and their reasons to this JSON file "
+    "in addition to stdout",
+)
 @_reporter_options
 def batch_build(
     config_file: str,
@@ -290,6 +313,8 @@ def batch_build(
     num_processes: int,
     process_id: int,
     model_register_dir: str,
+    fail_fast: bool,
+    quarantine_report_file: str,
     exceptions_reporter_file: str,
     exceptions_report_level: str,
 ):
@@ -298,6 +323,12 @@ def batch_build(
     (the TPU-native replacement for per-machine worker pods). With
     --coordinator-address/--num-processes/--process-id the mesh spans hosts
     and each host trains + saves its shard of the fleet.
+
+    Fault domains: a machine whose data fetch, validation, or training
+    fails is QUARANTINED (reasons recorded in its BuildMetadata and the
+    exit report) while the rest of the fleet builds on. Exit code 0 = all
+    machines built, 81 = partial (some quarantined), 82 = none built.
+    --fail-fast restores abort-on-first-fault.
     """
     # same exceptions-reporter/exit-code plumbing as `build`: the workflow
     # template wires EXCEPTIONS_REPORTER_FILE + terminationMessagePath to
@@ -332,6 +363,7 @@ def batch_build(
             serial_fallback=not no_serial_fallback,
             output_dir=output_dir,
             model_register_dir=model_register_dir,
+            fail_fast=fail_fast,
         )
         # the builder persists every machine as soon as its chunk finishes
         # (checkpoint/resume); reporting stays here, after the fleet
@@ -343,6 +375,9 @@ def batch_build(
                 f"built: {machine_out.name} -> "
                 f"{os.path.join(output_dir, machine_out.name)}"
             )
+        _report_quarantine_and_exit(
+            builder, len(results), quarantine_report_file
+        )
     except click.ClickException:
         raise  # a usage error (e.g. unknown --machines name), not a failure
     except Exception:
@@ -350,6 +385,34 @@ def batch_build(
             exceptions_reporter_file, exceptions_report_level
         )
     return 0
+
+
+def _report_quarantine_and_exit(
+    builder, n_built: int, quarantine_report_file: str
+) -> None:
+    """The fleet-build exit report: one line per quarantined machine, an
+    optional JSON report file, and the documented exit-code contract
+    (0 all built / 81 partial / 82 none built; docs/robustness.md)."""
+    records = builder.quarantine_records
+    for record in records:
+        click.echo(
+            f"quarantined: {record.machine} stage={record.stage} "
+            f"reason={record.reason} attempts={record.attempts} "
+            f"error={record.error}",
+            err=True,
+        )
+    if quarantine_report_file:
+        with open(quarantine_report_file, "w") as f:
+            json.dump(
+                {
+                    "built": n_built,
+                    "quarantined": [r.to_dict() for r in records],
+                },
+                f,
+                indent=2,
+            )
+    if records:
+        sys.exit(EXIT_PARTIAL if n_built else EXIT_NONE_BUILT)
 
 
 @click.command("run-server")

@@ -12,8 +12,9 @@ the server batches compute on device).
 
 import concurrent.futures
 import logging
+import os
 from datetime import datetime
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import pandas as pd
 
@@ -24,6 +25,30 @@ from .io import NotFound, _handle_response
 from .utils import PredictionResult
 
 logger = logging.getLogger(__name__)
+
+# default (connect, read) timeout: urllib3's Retry only covers requests that
+# FAIL — a server that accepts the connection and then hangs would block a
+# fleet download forever without a read timeout
+DEFAULT_TIMEOUT: Tuple[float, float] = (10.0, 300.0)
+TIMEOUT_ENV = "GORDO_TPU_CLIENT_TIMEOUT"
+
+
+def _timeout_from_env() -> Tuple[float, float]:
+    """Parse ``GORDO_TPU_CLIENT_TIMEOUT``: ``"connect,read"`` seconds, or a
+    single number applied to both. Invalid values keep the default."""
+    raw = os.environ.get(TIMEOUT_ENV)
+    if not raw:
+        return DEFAULT_TIMEOUT
+    try:
+        parts = [float(p) for p in raw.split(",")]
+    except ValueError:
+        logger.warning(
+            "Invalid %s=%r; using default %s", TIMEOUT_ENV, raw, DEFAULT_TIMEOUT
+        )
+        return DEFAULT_TIMEOUT
+    if len(parts) == 1:
+        return (parts[0], parts[0])
+    return (parts[0], parts[1])
 
 
 class Client:
@@ -45,6 +70,7 @@ class Client:
         use_parquet: bool = True,
         data_provider: Optional[Any] = None,
         session: Optional[Any] = None,
+        timeout: Optional[Union[float, Tuple[float, float]]] = None,
     ):
         self.project_name = project
         self.base_url = f"{scheme}://{host}:{port}/gordo/v0/{project}"
@@ -54,6 +80,14 @@ class Client:
         self.parallelism = max(1, parallelism)
         self.use_parquet = use_parquet
         self.data_provider = data_provider
+        # (connect, read) timeout carried by EVERY session call, including
+        # the _fan_out fetchers — without it a hung server wedges a fleet
+        # download despite the retry adapter (it never sees a response)
+        if timeout is None:
+            timeout = _timeout_from_env()
+        self.timeout = (
+            (timeout, timeout) if isinstance(timeout, (int, float)) else timeout
+        )
         if session is None:
             import requests
             from requests.adapters import HTTPAdapter, Retry
@@ -78,12 +112,16 @@ class Client:
         return {"revision": revision} if revision else {}
 
     def get_revisions(self) -> dict:
-        resp = self.session.get(f"{self.base_url}/revisions")
+        resp = self.session.get(
+            f"{self.base_url}/revisions", timeout=self.timeout
+        )
         return _handle_response(resp, "revisions")
 
     def get_available_machines(self, revision: Optional[str] = None) -> dict:
         resp = self.session.get(
-            f"{self.base_url}/models", params=self._params(revision)
+            f"{self.base_url}/models",
+            params=self._params(revision),
+            timeout=self.timeout,
         )
         return _handle_response(resp, "model list")
 
@@ -107,6 +145,7 @@ class Client:
             resp = self.session.get(
                 f"{self.base_url}/{name}/metadata",
                 params=self._params(revision),
+                timeout=self.timeout,
             )
             return _handle_response(resp, f"metadata for {name}").get(
                 "metadata", {}
@@ -126,6 +165,7 @@ class Client:
             resp = self.session.get(
                 f"{self.base_url}/{name}/download-model",
                 params=self._params(revision),
+                timeout=self.timeout,
             )
             return serializer.loads(
                 _handle_response(resp, f"model for {name}")
@@ -308,12 +348,16 @@ class Client:
                 files["y"] = _io.BytesIO(
                     server_utils.dataframe_into_parquet_bytes(y)
                 )
-            resp = self.session.post(url, files=files, params=params)
+            resp = self.session.post(
+                url, files=files, params=params, timeout=self.timeout
+            )
         else:
             payload = {"X": server_utils.dataframe_to_dict(X)}
             if y is not None:
                 payload["y"] = server_utils.dataframe_to_dict(y)
-            resp = self.session.post(url, json=payload, params=params)
+            resp = self.session.post(
+                url, json=payload, params=params, timeout=self.timeout
+            )
         content = _handle_response(resp, f"prediction for {name}")
         if isinstance(content, bytes):
             return server_utils.dataframe_from_parquet_bytes(content)

@@ -9,7 +9,10 @@ durations), serialized with dataclasses_json just like the reference.
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
-from dataclasses_json import dataclass_json
+try:
+    from dataclasses_json import dataclass_json
+except ImportError:  # pragma: no cover - environment-dependent
+    from gordo_tpu.util._dataclasses_json import dataclass_json
 
 
 @dataclass_json
@@ -45,6 +48,12 @@ class DatasetBuildMetadata:
 class BuildMetadata:
     model: ModelBuildMetadata = field(default_factory=ModelBuildMetadata)
     dataset: DatasetBuildMetadata = field(default_factory=DatasetBuildMetadata)
+    # fault-domain outcome for fleet builds (util/faults.py): quarantine
+    # records ({"quarantined": True, "stage", "reason", "error", "attempts"})
+    # or retry provenance for machines that recovered
+    # ({"quarantined": False, "data_fetch_attempts": n}); empty for a clean
+    # single-attempt build
+    fault_domain: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass_json

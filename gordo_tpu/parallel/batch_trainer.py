@@ -67,6 +67,8 @@ from gordo_tpu.ops.train import (
     make_scanned_fit,
     n_train_samples,
 )
+from gordo_tpu.util import faults
+from gordo_tpu.util.faults import FaultPolicy, QuarantineRecord
 from .mesh import default_mesh, machines_sharding
 
 logger = logging.getLogger(__name__)
@@ -105,6 +107,9 @@ class _Plan:
     target_columns: Optional[List[str]] = None
     query_duration: float = 0.0
     dataset_meta: Dict[str, Any] = field(default_factory=dict)
+    # how many data-fetch attempts it took (>1 = transient faults absorbed;
+    # recorded in BuildMetadata.fault_domain for observability)
+    fetch_attempts: int = 1
 
     def bucket_key(self) -> Tuple:
         return (
@@ -531,6 +536,8 @@ class BatchedModelBuilder:
         output_dir: Optional[str] = None,
         model_register_dir: Optional[str] = None,
         replace_cache: bool = False,
+        fail_fast: bool = False,
+        fault_policy: Optional[FaultPolicy] = None,
     ):
         """
         ``chunk_size``: machines per compiled program. Large buckets are cut
@@ -551,6 +558,13 @@ class BatchedModelBuilder:
         retrained (the fleet-scale form of the reference's whole-model cache,
         gordo/builder/build_model.py:92-167). ``replace_cache`` forces
         retraining, as in the serial builder.
+
+        ``fail_fast``: restore pre-fault-domain behavior — the first fault
+        aborts the whole build instead of quarantining the machine and
+        degrading machine-by-machine (docs/robustness.md).
+
+        ``fault_policy``: retry/backoff/classification policy; defaults to
+        ``FaultPolicy.from_env()`` (``GORDO_TPU_FAULT_*`` variables).
         """
         self.machines = machines
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -561,19 +575,96 @@ class BatchedModelBuilder:
         self.output_dir = output_dir
         self.model_register_dir = model_register_dir
         self.replace_cache = replace_cache
+        self.fail_fast = fail_fast
+        self.fault_policy = fault_policy or FaultPolicy.from_env()
+        # fault-domain outcome of the last build(): Machine objects whose
+        # BuildMetadata.fault_domain records stage/reason, plus the raw
+        # records (the CLI exit report reads both)
+        self.quarantined: List[Machine] = []
+        self.quarantine_records: List[QuarantineRecord] = []
+        self._quarantined_names: set = set()
 
     # -------------------------------------------------------------- data
     def _load_data(self, plan: _Plan):
         t0 = time.time()
+        faults.fault_point("data_fetch", machine=plan.machine.name)
         dataset = GordoBaseDataset.from_dict(plan.machine.dataset.to_dict())
         X, y = dataset.get_data()
-        plan.X = np.ascontiguousarray(X.to_numpy(np.float32))
+        plan.X = faults.maybe_poison(
+            plan.machine.name, np.ascontiguousarray(X.to_numpy(np.float32))
+        )
         plan.y = np.ascontiguousarray(y.to_numpy(np.float32))
         plan.index = X.index
         plan.columns = list(X.columns)
         plan.target_columns = list(y.columns)
         plan.query_duration = time.time() - t0
         plan.dataset_meta = dataset.get_metadata()
+
+    def _load_data_guarded(self, plan: _Plan) -> Optional[QuarantineRecord]:
+        """Per-machine data fetch with transient retry + backoff; returns a
+        quarantine record instead of raising once attempts are exhausted (a
+        single machine's feed outage must not abort the fleet)."""
+        if self.fail_fast:
+            self._load_data(plan)
+            return None
+        name = plan.machine.name
+        try:
+            _, attempts = faults.retry_call(
+                lambda: self._load_data(plan),
+                self.fault_policy,
+                key=name,
+                describe=f"data fetch for machine {name}",
+            )
+            plan.fetch_attempts = attempts
+            return None
+        except Exception as exc:
+            kind = self.fault_policy.classify(exc)
+            return QuarantineRecord(
+                machine=name,
+                stage=faults.STAGE_DATA_FETCH,
+                reason=f"{kind}_fetch_failure",
+                error=f"{type(exc).__name__}: {exc}",
+                attempts=(
+                    self.fault_policy.max_attempts if kind == "transient" else 1
+                ),
+            )
+
+    # -------------------------------------------------------- quarantine
+    def _quarantine(
+        self,
+        machine: Machine,
+        stage: str = "",
+        reason: str = "",
+        error: str = "",
+        attempts: int = 1,
+        record: Optional[QuarantineRecord] = None,
+    ) -> None:
+        """Drop one machine from the build, recording why. The machine's
+        reasons land in a fresh ``BuildMetadata.fault_domain`` (the fleet
+        analog of a crashed pod's termination message)."""
+        if record is None:
+            record = QuarantineRecord(machine.name, stage, reason, error, attempts)
+        logger.error(
+            "Machine %s QUARANTINED at %s (%s): %s",
+            record.machine, record.stage, record.reason, record.error,
+        )
+        machine_out = Machine(
+            name=machine.name,
+            dataset=machine.dataset.to_dict(),
+            # to_dict round-trip: the quarantined copy must not alias (and
+            # mutate) the input machine's Metadata
+            metadata=machine.metadata.to_dict(),
+            model=machine.model,
+            project_name=machine.project_name,
+            evaluation=machine.evaluation,
+            runtime=machine.runtime,
+        )
+        machine_out.metadata.build_metadata = BuildMetadata(
+            fault_domain=record.to_dict()
+        )
+        self.quarantine_records.append(record)
+        self.quarantined.append(machine_out)
+        self._quarantined_names.add(machine.name)
 
     # ------------------------------------------------------------- build
     def build(self) -> List[Tuple[Any, Machine]]:
@@ -590,6 +681,9 @@ class BatchedModelBuilder:
         from gordo_tpu.parallel import distributed
         from gordo_tpu.util.profiling import maybe_profile
 
+        self.quarantined = []
+        self.quarantine_records = []
+        self._quarantined_names = set()
         with maybe_profile("batched-build"):
             return self._build_all(distributed)
 
@@ -608,6 +702,28 @@ class BatchedModelBuilder:
             )
             return None
         return ModelBuilder(machine).check_cache(self.model_register_dir)
+
+    def _load_cached_guarded(self, i: int, path: str):
+        """Unpickle one cache hit; a corrupt/truncated artifact must not
+        kill a resuming fleet build — evict the registry entry and let the
+        machine rebuild through the normal path instead."""
+        try:
+            return ModelBuilder.load_from_cache(path)
+        except Exception as exc:
+            if self.fail_fast:
+                raise
+            logger.warning(
+                "Machine %s: corrupt cache artifact at %s (%s: %s); "
+                "evicting registry entry and rebuilding",
+                self.machines[i].name, path, type(exc).__name__, exc,
+            )
+            from gordo_tpu.util import disk_registry
+
+            disk_registry.delete_value(
+                self.model_register_dir,
+                ModelBuilder.calculate_cache_key(self.machines[i]),
+            )
+            return None
 
     def _persist(self, machine: Machine, model, machine_out: Machine) -> None:
         """Dump + register one machine the moment it is assembled, so an
@@ -661,9 +777,13 @@ class BatchedModelBuilder:
                     max_workers=min(16, len(owned_hits))
                 ) as pool:
                     loaded = pool.map(
-                        lambda ip: ModelBuilder.load_from_cache(ip[1]), owned_hits
+                        lambda ip: self._load_cached_guarded(*ip), owned_hits
                     )
-                    cached_results = {i: c for (i, _), c in zip(owned_hits, loaded)}
+                    cached_results = {
+                        i: c
+                        for (i, _), c in zip(owned_hits, loaded)
+                        if c is not None
+                    }
 
         for i, machine in enumerate(self.machines):
             if i in foreign_cached:
@@ -702,24 +822,62 @@ class BatchedModelBuilder:
             ):
                 continue
             logger.info("Machine %s: serial fallback", self.machines[i].name)
-            results[i] = ModelBuilder(self.machines[i]).build(
-                output_dir=self._machine_output_dir(self.machines[i].name),
-                model_register_dir=self.model_register_dir,
-            )
+            try:
+                results[i] = ModelBuilder(self.machines[i]).build(
+                    output_dir=self._machine_output_dir(self.machines[i].name),
+                    model_register_dir=self.model_register_dir,
+                )
+            except Exception as exc:
+                if self.fail_fast:
+                    raise
+                self._quarantine(
+                    self.machines[i],
+                    stage=faults.STAGE_SERIAL_BUILD,
+                    reason=type(exc).__name__,
+                    error=str(exc),
+                )
 
         # fetch data concurrently (provider I/O is the per-machine serial cost
-        # the reference paid per pod), then bucket by (spec, shapes, config)
+        # the reference paid per pod), then bucket by (spec, shapes, config).
+        # Each fetch retries transient faults with backoff and quarantines
+        # the machine on exhaustion — one dead sensor feed degrades one
+        # machine, not the fleet (the blast radius the reference got from
+        # one-pod-per-machine)
         if plans:
             max_workers = min(16, len(plans))
             with ThreadPoolExecutor(max_workers=max_workers) as pool:
-                list(pool.map(self._load_data, plans.values()))
+                records = list(pool.map(self._load_data_guarded, plans.values()))
+            for (i, plan), record in zip(list(plans.items()), records):
+                if record is not None:
+                    self._quarantine(plan.machine, record=record)
+                    del plans[i]
+
+        # pre-flight validation: a NaN column would train to NaN params and
+        # poison nothing but its own vmap lane — but its thresholds/scores
+        # would be garbage and, pre-bucketing, it is trivially isolable
+        for i in list(plans):
+            plan = plans[i]
+            bad = faults.non_finite_report(plan.X, plan.y)
+            if bad is not None:
+                if self.fail_fast:
+                    raise faults.NonFiniteDataError(
+                        f"machine {plan.machine.name}: {bad}"
+                    )
+                self._quarantine(
+                    plan.machine,
+                    stage=faults.STAGE_DATA_VALIDATION,
+                    reason="non_finite_data",
+                    error=bad,
+                )
+                del plans[i]
+
         buckets: Dict[Tuple, List[int]] = {}
         for i, plan in plans.items():
             buckets.setdefault(plan.bucket_key(), []).append(i)
 
         for key, idxs in buckets.items():
             bucket_plans = [plans[i] for i in idxs]
-            for i, built in self._build_bucket(bucket_plans, idxs):
+            for i, built in self._build_bucket_guarded(bucket_plans, idxs):
                 results[i] = built
 
         return [results[i] for i in sorted(results)]
@@ -731,9 +889,104 @@ class BatchedModelBuilder:
             bounds.append((int(train_idx[-1]) + 1, int(test_idx[0]), int(test_idx[-1]) + 1))
         return tuple(bounds)
 
+    def _build_bucket_guarded(
+        self,
+        bucket: List[_Plan],
+        global_idxs: List[int],
+        attempt: int = 1,
+    ) -> List[Tuple[int, Tuple[Any, Machine]]]:
+        """Run one bucket with the fault-domain recovery ladder:
+
+        1. transient failure → retry the bucket (minus any members
+           quarantined in the meantime) with backoff, up to the policy's
+           attempt budget;
+        2. device OOM → bisect the bucket and recurse on each half (each
+           sub-bucket compiles with half the machine axis, so peak HBM
+           halves too — the in-process analog of rescheduling pods onto
+           emptier nodes);
+        3. anything else, or an exhausted budget → per-machine serial
+           ``ModelBuilder`` as the last resort, quarantining machines whose
+           serial build also fails.
+
+        ``fail_fast`` skips the whole ladder (pre-fault-domain behavior).
+        """
+        # drop members quarantined since this bucket was assembled (e.g. on
+        # the retry after a mixed failure)
+        live = [
+            (p, i)
+            for p, i in zip(bucket, global_idxs)
+            if p.machine.name not in self._quarantined_names
+        ]
+        if not live:
+            return []
+        bucket = [p for p, _ in live]
+        global_idxs = [i for _, i in live]
+        if self.fail_fast:
+            return self._build_bucket(bucket, global_idxs)
+        try:
+            return self._build_bucket(bucket, global_idxs)
+        except Exception as exc:
+            names = [p.machine.name for p in bucket]
+            if faults.is_oom(exc) and len(bucket) > 1:
+                mid = len(bucket) // 2
+                logger.warning(
+                    "Bucket of %d machines hit device OOM (%s); bisecting "
+                    "into %d + %d", len(bucket), exc, mid, len(bucket) - mid,
+                )
+                return self._build_bucket_guarded(
+                    bucket[:mid], global_idxs[:mid]
+                ) + self._build_bucket_guarded(bucket[mid:], global_idxs[mid:])
+            if (
+                self.fault_policy.classify(exc) == "transient"
+                and attempt < self.fault_policy.max_attempts
+            ):
+                delay = self.fault_policy.backoff(attempt, names[0])
+                logger.warning(
+                    "Bucket of %d machines failed transiently "
+                    "(attempt %d/%d, retrying in %.2fs): %s",
+                    len(bucket), attempt, self.fault_policy.max_attempts,
+                    delay, exc,
+                )
+                time.sleep(delay)
+                return self._build_bucket_guarded(
+                    bucket, global_idxs, attempt=attempt + 1
+                )
+            logger.warning(
+                "Bucket of %d machines failed (%s: %s); falling back to "
+                "serial builds per machine", len(bucket),
+                type(exc).__name__, exc,
+            )
+            return self._bucket_serial_last_resort(bucket, global_idxs)
+
+    def _bucket_serial_last_resort(
+        self, bucket: List[_Plan], global_idxs: List[int]
+    ) -> List[Tuple[int, Tuple[Any, Machine]]]:
+        """Per-machine serial rebuild of a failed bucket: capability over
+        speed, and per-machine blast radius — a machine whose serial build
+        also fails is quarantined, never the fleet."""
+        out = []
+        for i, plan in zip(global_idxs, bucket):
+            try:
+                built = ModelBuilder(plan.machine).build(
+                    output_dir=self._machine_output_dir(plan.machine.name),
+                    model_register_dir=self.model_register_dir,
+                )
+                out.append((i, built))
+            except Exception as exc:
+                self._quarantine(
+                    plan.machine,
+                    stage=faults.STAGE_TRAINING,
+                    reason=type(exc).__name__,
+                    error=str(exc),
+                )
+        return out
+
     def _build_bucket(
         self, bucket: List[_Plan], global_idxs: List[int]
     ) -> List[Tuple[int, Tuple[Any, Machine]]]:
+        faults.fault_point(
+            "bucket_compile", machines=[p.machine.name for p in bucket]
+        )
         plan0 = bucket[0]
         spec = plan0.spec
         n_rows = len(plan0.X)
@@ -872,6 +1125,27 @@ class BatchedModelBuilder:
                     continue  # padding rows replicate group[0]; skip
                 params_i = jax.tree_util.tree_map(lambda a: a[j], params_stack)
                 fold_preds_i = [fp[j] for fp in fold_preds]
+                # post-build divergence detection: a lane that trained to
+                # NaN/Inf params (bad lr, degenerate data) is quarantined —
+                # its garbage must not be persisted as a servable artifact
+                bad = faults.params_non_finite(params_i, losses[j])
+                if bad is None and faults.should_fire(
+                    "diverge", group[row].machine.name
+                ):
+                    bad = "injected divergence"
+                if bad is not None:
+                    plan = group[row]
+                    if self.fail_fast:
+                        raise faults.DivergedModelError(
+                            f"machine {plan.machine.name}: {bad}"
+                        )
+                    self._quarantine(
+                        plan.machine,
+                        stage=faults.STAGE_TRAINING,
+                        reason="diverged",
+                        error=bad,
+                    )
+                    continue
                 futures.append(
                     pool.submit(
                         lambda idx, plan, p, l, fp: (
@@ -1025,6 +1299,11 @@ class BatchedModelBuilder:
             dataset=DatasetBuildMetadata(
                 query_duration_sec=plan.query_duration,
                 dataset_meta=plan.dataset_meta,
+            ),
+            fault_domain=(
+                {"quarantined": False, "data_fetch_attempts": plan.fetch_attempts}
+                if plan.fetch_attempts > 1
+                else {}
             ),
         )
         return model, machine_out

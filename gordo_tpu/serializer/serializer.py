@@ -16,7 +16,10 @@ import os
 import pickle
 from typing import Any, Optional, Union
 
-import simplejson
+try:
+    import simplejson
+except ImportError:  # pragma: no cover - environment-dependent
+    from gordo_tpu.util import _simplejson as simplejson
 
 
 def dumps(model: Any) -> bytes:

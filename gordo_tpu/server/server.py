@@ -20,7 +20,10 @@ import re
 import timeit
 from typing import Any, Dict, Optional
 
-import simplejson
+try:
+    import simplejson
+except ImportError:  # pragma: no cover - environment-dependent
+    from gordo_tpu.util import _simplejson as simplejson
 from werkzeug.exceptions import HTTPException, MethodNotAllowed
 from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response

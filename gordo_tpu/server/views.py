@@ -34,7 +34,10 @@ DELETED_FROM_RESPONSE_COLUMNS = (
 
 
 def json_response(ctx, payload: dict, status: int = 200) -> Response:
-    import simplejson
+    try:
+        import simplejson
+    except ImportError:  # pragma: no cover - environment-dependent
+        from gordo_tpu.util import _simplejson as simplejson
 
     payload = dict(payload)
     payload["revision"] = ctx.revision

@@ -1,0 +1,59 @@
+"""
+Fallback for ``dataclasses_json``'s ``@dataclass_json`` decorator.
+
+Environments without the real package (see the guarded import in
+``machine.metadata``) get the same used surface: ``to_dict()`` and
+``from_dict()`` with recursion into nested dataclass fields. Unknown keys in
+``from_dict`` input are ignored, matching dataclasses_json's default
+(metadata.json written by a newer builder must still load in an older one).
+"""
+
+import dataclasses
+import typing
+from typing import Any, Dict
+
+
+def _resolved_hints(cls) -> Dict[str, Any]:
+    try:
+        return typing.get_type_hints(cls)
+    except Exception:
+        # string annotations that fail to resolve: fall back to raw values
+        return {f.name: f.type for f in dataclasses.fields(cls)}
+
+
+def dataclass_json(cls):
+    """Add ``to_dict``/``from_dict`` to a dataclass, recursing into fields
+    that are themselves dataclasses."""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(klass, data: dict):
+        hints = _resolved_hints(klass)
+        kwargs = {}
+        for f in dataclasses.fields(klass):
+            if f.name not in data:
+                continue
+            value = data[f.name]
+            field_type = hints.get(f.name, f.type)
+            # Optional[X] unwraps to X for the nested-dataclass check
+            if typing.get_origin(field_type) is typing.Union:
+                args = [
+                    a for a in typing.get_args(field_type) if a is not type(None)
+                ]
+                if len(args) == 1:
+                    field_type = args[0]
+            if dataclasses.is_dataclass(field_type) and isinstance(value, dict):
+                nested_from = getattr(field_type, "from_dict", None)
+                value = (
+                    nested_from(value)
+                    if nested_from is not None
+                    else field_type(**value)
+                )
+            kwargs[f.name] = value
+        return klass(**kwargs)
+
+    cls.to_dict = to_dict
+    cls.from_dict = from_dict
+    return cls

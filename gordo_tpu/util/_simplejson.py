@@ -1,0 +1,47 @@
+"""
+Fallback for ``simplejson`` built on the stdlib ``json`` module.
+
+Environments without the real simplejson (its wheel is not baked into every
+runtime image) import this shim instead — see the guarded imports in
+``serializer.serializer``, ``server.server`` and ``server.views``. Only the
+surface gordo_tpu actually uses is provided: ``load``/``loads``/``dump``/
+``dumps`` plus the ``ignore_nan`` extension (non-finite floats serialize as
+``null``, which is what the real simplejson does and what the prediction
+views rely on — NaN is not valid JSON).
+"""
+
+import json
+import math
+from typing import Any, IO
+
+
+def _sanitize(obj: Any) -> Any:
+    """Recursively replace non-finite floats with None (simplejson's
+    ``ignore_nan=True`` behavior)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def dumps(obj: Any, ignore_nan: bool = False, default=None, **kwargs) -> str:
+    if ignore_nan:
+        obj = _sanitize(obj)
+    return json.dumps(obj, default=default, **kwargs)
+
+
+def dump(obj: Any, fp: IO, ignore_nan: bool = False, default=None, **kwargs) -> None:
+    if ignore_nan:
+        obj = _sanitize(obj)
+    json.dump(obj, fp, default=default, **kwargs)
+
+
+def loads(s) -> Any:
+    return json.loads(s)
+
+
+def load(fp: IO) -> Any:
+    return json.load(fp)

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""
+Lint: reject bare ``except:`` clauses under gordo_tpu/.
+
+A bare except swallows KeyboardInterrupt/SystemExit and defeats the fault
+classification the robustness layer depends on (util/faults.py decides
+transient-vs-permanent by exception type — an exception laundered into a
+generic code path upstream can never be classified). Catch a specific
+exception, or at least ``Exception``; catch ``BaseException`` only to
+re-raise (fan-out/cleanup paths), and say why in a comment.
+
+Usage: ``python scripts/lint_bare_except.py [root ...]`` (default:
+``gordo_tpu``). Exit 0 = clean, 1 = violations (printed one per line),
+2 = a file failed to parse. Wired into tier-1 via
+tests/gordo_tpu/test_lint.py.
+"""
+
+import ast
+import pathlib
+import sys
+from typing import List
+
+
+def find_bare_excepts(root: str) -> List[str]:
+    violations = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                violations.append(
+                    f"{path}:{node.lineno}: bare 'except:' — catch a "
+                    f"specific exception (or at least Exception) so "
+                    f"util/faults.py can classify it"
+                )
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    roots = argv or ["gordo_tpu"]
+    violations = []
+    for root in roots:
+        try:
+            violations.extend(find_bare_excepts(root))
+        except SyntaxError as exc:
+            print(f"parse error: {exc}", file=sys.stderr)
+            return 2
+    for line in violations:
+        print(line)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,365 @@
+"""
+Chaos tests: fleet builds under the deterministic fault-injection harness
+(``GORDO_TPU_FAULT_PLAN``, util/faults.py).
+
+The headline scenario mirrors the reference's blast-radius guarantee: with
+one pod per machine, a bad sensor feed killed one pod. Here 12 machines
+train in one process under a plan injecting transient fetch failures,
+a permanent fetch failure, NaN-poisoned data, and a device OOM on the
+bucket's first compile — and the build must degrade machine-by-machine:
+exactly the genuinely-bad machines quarantined (reasons recorded in
+BuildMetadata), byte-identical artifacts for the rest vs a fault-free run,
+and the documented partial-success exit code from the CLI.
+"""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+import yaml
+
+from gordo_tpu import serializer
+from gordo_tpu.parallel import BatchedModelBuilder
+from gordo_tpu.util import faults
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_state(monkeypatch):
+    """Each test gets a fresh fault plan (counters re-armed) and instant
+    backoff; the plan env never leaks between tests."""
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    monkeypatch.setenv("GORDO_TPU_FAULT_BACKOFF_BASE", "0")
+    faults.reset_plan()
+    yield
+    faults.reset_plan()
+
+
+def _machine_block(name, n_tags=4):
+    tags = "".join(f"\n      - {name}-tag-{j}" for j in range(n_tags))
+    return f"""
+  - name: {name}
+    dataset:
+      tags:{tags}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+      data_provider: {{type: RandomDataProvider}}
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        require_thresholds: true
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+            - sklearn.preprocessing.MinMaxScaler
+            - gordo_tpu.models.models.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: 1
+"""
+
+
+def _machines(prefix, n):
+    cfg = "machines:" + "".join(_machine_block(f"{prefix}-{i}") for i in range(n))
+    return NormalizedConfig(yaml.safe_load(cfg), project_name="chaos").machines
+
+
+def _set_plan(monkeypatch, rules):
+    monkeypatch.setenv(faults.PLAN_ENV, json.dumps({"rules": rules}))
+    faults.reset_plan()
+
+
+# ------------------------------------------------------- headline scenario
+def test_chaos_fleet_degrades_machine_by_machine(monkeypatch):
+    """12-machine fleet under the full fault plan: transient fetch failures
+    on 3 machines, a permanent fetch failure on 1, NaN-poisoned data on 1,
+    and an injected OOM on the bucket's first compile. Exactly the 2
+    genuinely-bad machines are quarantined with reasons in BuildMetadata;
+    the other 10 produce byte-identical artifacts vs a fault-free run."""
+    machines = _machines("fm", 12)
+
+    # chunk pinned to the mesh size: the compiled dispatch shape is then
+    # invariant to fleet composition, quarantine, and OOM bisection, which
+    # is what makes artifacts bitwise-reproducible across degraded builds
+    # (vmap lanes are bitwise-independent of bucket MEMBERSHIP at any
+    # chunk, but XLA may round differently across compiled WIDTHS —
+    # docs/robustness.md "Determinism")
+    chunk = 8
+
+    # fault-free reference run
+    baseline = {
+        m.name: pickle.dumps(model)
+        for model, m in BatchedModelBuilder(machines, chunk_size=chunk).build()
+    }
+    assert len(baseline) == 12
+
+    _set_plan(
+        monkeypatch,
+        [
+            {"site": "data_fetch", "machine": "fm-1", "times": 2,
+             "error": "transient"},
+            {"site": "data_fetch", "machine": "fm-3", "times": 2,
+             "error": "transient"},
+            {"site": "data_fetch", "machine": "fm-5", "times": 1,
+             "error": "transient"},
+            {"site": "data_fetch", "machine": "fm-7", "times": -1,
+             "error": "permanent"},
+            {"site": "poison_nan", "machine": "fm-9"},
+            {"site": "bucket_compile", "machine": "fm-0", "times": 1,
+             "error": "resource_exhausted"},
+        ],
+    )
+    builder = BatchedModelBuilder(machines, chunk_size=chunk)
+    results = builder.build()
+
+    built = {m.name: pickle.dumps(model) for model, m in results}
+    assert sorted(built) == sorted(set(baseline) - {"fm-7", "fm-9"})
+
+    # exactly the two genuinely-bad machines quarantined, with reasons
+    by_name = {r.machine: r for r in builder.quarantine_records}
+    assert set(by_name) == {"fm-7", "fm-9"}
+    assert by_name["fm-7"].stage == faults.STAGE_DATA_FETCH
+    assert by_name["fm-7"].reason == "permanent_fetch_failure"
+    assert by_name["fm-9"].stage == faults.STAGE_DATA_VALIDATION
+    assert by_name["fm-9"].reason == "non_finite_data"
+    # ... and the reasons land in the quarantined machines' BuildMetadata
+    for machine_out in builder.quarantined:
+        fault_domain = machine_out.metadata.build_metadata.fault_domain
+        assert fault_domain["quarantined"] is True
+        assert fault_domain["stage"] == by_name[machine_out.name].stage
+        assert fault_domain["reason"] == by_name[machine_out.name].reason
+
+    # byte-identical artifacts for every surviving machine
+    for name, blob in built.items():
+        assert blob == baseline[name], f"artifact for {name} drifted"
+
+    # the machines that recovered through retries record their attempts
+    recovered = {
+        m.name: m.metadata.build_metadata.fault_domain
+        for _, m in results
+        if m.metadata.build_metadata.fault_domain
+    }
+    assert recovered == {
+        "fm-1": {"quarantined": False, "data_fetch_attempts": 3},
+        "fm-3": {"quarantined": False, "data_fetch_attempts": 3},
+        "fm-5": {"quarantined": False, "data_fetch_attempts": 2},
+    }
+
+
+# --------------------------------------------------------- recovery ladder
+def test_transient_bucket_failure_retries_and_succeeds(monkeypatch):
+    machines = _machines("tb", 2)
+    _set_plan(
+        monkeypatch,
+        [{"site": "bucket_compile", "machine": "tb-0", "times": 1,
+          "error": "transient"}],
+    )
+    builder = BatchedModelBuilder(machines)
+    results = builder.build()
+    assert len(results) == 2
+    assert builder.quarantine_records == []
+
+
+def test_permanent_bucket_failure_falls_back_to_serial(monkeypatch):
+    """A bucket failure that is neither OOM nor transient ends in the
+    last-resort ladder rung: per-machine serial ModelBuilder builds."""
+    machines = _machines("pb", 2)
+    _set_plan(
+        monkeypatch,
+        [{"site": "bucket_compile", "machine": "pb-0", "times": -1,
+          "error": "permanent"}],
+    )
+    builder = BatchedModelBuilder(machines)
+    results = builder.build()
+    assert len(results) == 2
+    assert builder.quarantine_records == []
+    for model, machine_out in results:
+        md = machine_out.metadata.build_metadata.model
+        assert md.cross_validation.scores  # a real build, not a stub
+
+
+def test_oom_bisection_recurses_to_singletons(monkeypatch):
+    """Repeated OOM bisects down to single-machine buckets; a singleton that
+    still OOMs falls back to the serial builder rather than aborting."""
+    machines = _machines("ob", 4)
+    _set_plan(
+        monkeypatch,
+        [{"site": "bucket_compile", "machine": "ob-0", "times": 3,
+          "error": "resource_exhausted"}],
+    )
+    builder = BatchedModelBuilder(machines)
+    results = builder.build()
+    assert len(results) == 4
+    assert builder.quarantine_records == []
+
+
+def test_diverged_machine_is_quarantined(monkeypatch):
+    machines = _machines("dv", 2)
+    _set_plan(monkeypatch, [{"site": "diverge", "machine": "dv-1"}])
+    builder = BatchedModelBuilder(machines)
+    results = builder.build()
+    assert [m.name for _, m in results] == ["dv-0"]
+    [record] = builder.quarantine_records
+    assert record.machine == "dv-1"
+    assert record.stage == faults.STAGE_TRAINING
+    assert record.reason == "diverged"
+
+
+def test_fail_fast_restores_abort_on_first_fault(monkeypatch):
+    machines = _machines("ff", 2)
+    _set_plan(
+        monkeypatch,
+        [{"site": "data_fetch", "machine": "ff-0", "times": -1,
+          "error": "permanent"}],
+    )
+    builder = BatchedModelBuilder(machines, fail_fast=True)
+    with pytest.raises(faults.PermanentFault):
+        builder.build()
+
+
+def test_fail_fast_raises_on_poisoned_data(monkeypatch):
+    machines = _machines("fp", 1)
+    _set_plan(monkeypatch, [{"site": "poison_nan", "machine": "fp-0"}])
+    builder = BatchedModelBuilder(machines, fail_fast=True)
+    with pytest.raises(faults.NonFiniteDataError):
+        builder.build()
+
+
+# ----------------------------------------------------------- cache resume
+def test_corrupt_cache_entry_is_evicted_and_rebuilt(tmp_path):
+    """A truncated/corrupt cached model.pkl must not kill a resuming fleet
+    build: the registry entry is evicted and the machine rebuilt."""
+    machines = _machines("cc", 2)
+    out_dir = str(tmp_path / "models")
+    reg_dir = str(tmp_path / "registry")
+    BatchedModelBuilder(
+        machines, output_dir=out_dir, model_register_dir=reg_dir
+    ).build()
+
+    # corrupt one cached artifact in place
+    corrupt_path = tmp_path / "models" / "cc-0" / "model.pkl"
+    corrupt_path.write_bytes(b"\x80\x04 truncated garbage")
+
+    builder = BatchedModelBuilder(
+        machines, output_dir=out_dir, model_register_dir=reg_dir
+    )
+    results = builder.build()
+    assert len(results) == 2
+    assert builder.quarantine_records == []
+    # the artifact was rebuilt in place and loads again
+    model = serializer.load(str(tmp_path / "models" / "cc-0"))
+    assert model is not None
+    # the clean machine still came from cache
+    cached = [
+        m for _, m in results
+        if m.metadata.user_defined.get("build-metadata", {}).get("from_cache")
+    ]
+    assert [m.name for m in cached] == ["cc-1"]
+
+
+# ------------------------------------------------------------ CLI contract
+def _write_config(tmp_path, prefix, n):
+    cfg = "machines:" + "".join(
+        _machine_block(f"{prefix}-{i}") for i in range(n)
+    )
+    config_file = tmp_path / "config.yaml"
+    config_file.write_text(cfg)
+    return str(config_file)
+
+
+def test_cli_partial_build_exit_code(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    config_file = _write_config(tmp_path, "cp", 2)
+    _set_plan(
+        monkeypatch,
+        [{"site": "data_fetch", "machine": "cp-1", "times": -1,
+          "error": "permanent"}],
+    )
+    report_file = tmp_path / "quarantine.json"
+    result = CliRunner().invoke(
+        gordo,
+        [
+            "batch-build", config_file,
+            "--output-dir", str(tmp_path / "models"),
+            "--quarantine-report-file", str(report_file),
+        ],
+    )
+    assert result.exit_code == faults.EXIT_PARTIAL, result.output
+    assert "quarantined: cp-1" in result.output
+    assert (tmp_path / "models" / "cp-0" / "model.pkl").exists()
+    report = json.loads(report_file.read_text())
+    assert report["built"] == 1
+    [record] = report["quarantined"]
+    assert record["machine"] == "cp-1"
+    assert record["stage"] == faults.STAGE_DATA_FETCH
+
+
+def test_cli_none_built_exit_code(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    config_file = _write_config(tmp_path, "cn", 1)
+    _set_plan(
+        monkeypatch,
+        [{"site": "data_fetch", "machine": "cn-0", "times": -1,
+          "error": "permanent"}],
+    )
+    result = CliRunner().invoke(
+        gordo,
+        ["batch-build", config_file, "--output-dir", str(tmp_path / "models")],
+    )
+    assert result.exit_code == faults.EXIT_NONE_BUILT, result.output
+
+
+def test_cli_fail_fast_flag_aborts(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    config_file = _write_config(tmp_path, "cf", 2)
+    _set_plan(
+        monkeypatch,
+        [{"site": "data_fetch", "machine": "cf-0", "times": -1,
+          "error": "permanent"}],
+    )
+    result = CliRunner().invoke(
+        gordo,
+        [
+            "batch-build", config_file,
+            "--output-dir", str(tmp_path / "models"),
+            "--fail-fast",
+        ],
+    )
+    # generic exception exit code from the exceptions reporter, not the
+    # partial-success contract: fail-fast aborts
+    assert result.exit_code == 1, result.output
+
+
+# ----------------------------------------------------- serial-path parity
+def test_serial_builder_retries_transient_fetch(monkeypatch, tmp_path):
+    from gordo_tpu.builder import ModelBuilder
+
+    [machine] = _machines("sr", 1)
+    _set_plan(
+        monkeypatch,
+        [{"site": "data_fetch", "machine": "sr-0", "times": 2,
+          "error": "transient"}],
+    )
+    model, machine_out = ModelBuilder(machine).build()
+    assert model is not None
+    fault_domain = machine_out.metadata.build_metadata.fault_domain
+    assert fault_domain == {"quarantined": False, "data_fetch_attempts": 3}
+
+
+def test_serial_builder_rejects_poisoned_data(monkeypatch):
+    from gordo_tpu.builder import ModelBuilder
+
+    [machine] = _machines("sp", 1)
+    _set_plan(monkeypatch, [{"site": "poison_nan", "machine": "sp-0"}])
+    with pytest.raises(faults.NonFiniteDataError):
+        ModelBuilder(machine).build()

@@ -166,6 +166,78 @@ def test_client_cli_metadata(app, gordo_project, gordo_name, monkeypatch, tmp_pa
     assert gordo_name in json.loads(out.read_text())
 
 
+def test_fan_out_first_failure_cancels_unstarted_and_raises_promptly():
+    """_fan_out's docstring promises: the first failure cancels the
+    unstarted remainder and propagates promptly, instead of draining every
+    queued doomed request (each with retry backoff) before raising."""
+    import threading
+    import time
+
+    client = Client(project="p", session=object())
+    client.parallelism = 2
+    started: list = []
+    lock = threading.Lock()
+
+    def fetch(name):
+        with lock:
+            started.append(name)
+        if name == "m-0":
+            raise RuntimeError("boom")
+        time.sleep(0.2)
+        return name
+
+    names = [f"m-{i}" for i in range(40)]
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="boom"):
+        client._fan_out(fetch, names)
+    elapsed = time.monotonic() - t0
+    # prompt: nowhere near the ~4s a full drain of 40 x 0.2s / 2 workers
+    # would take
+    assert elapsed < 2.0, f"failure propagated slowly ({elapsed:.1f}s)"
+    # unstarted fetches were cancelled, not run
+    assert len(started) < len(names)
+
+
+def test_client_calls_carry_timeout(monkeypatch):
+    """Every session call carries the (connect, read) timeout — a hung
+    server must hit the read timeout instead of blocking a fleet download
+    forever (urllib3's Retry never fires if no response ever arrives)."""
+    from gordo_tpu.client.client import DEFAULT_TIMEOUT, _timeout_from_env
+
+    captured = []
+
+    class StubResp:
+        status_code = 200
+        headers = {"Content-Type": "application/json"}
+        content = b"{}"
+
+        def json(self):
+            return {"models": ["m-0"]}
+
+    class StubSession:
+        def get(self, url, params=None, timeout=None, **kwargs):
+            captured.append(timeout)
+            return StubResp()
+
+    client = Client(project="p", session=StubSession())
+    client.get_available_machines()
+    client.get_metadata(targets=["m-0"])  # through the _fan_out fetchers
+    assert captured and all(t == DEFAULT_TIMEOUT for t in captured)
+
+    # env-configurable: "connect,read" or a single number for both
+    monkeypatch.setenv("GORDO_TPU_CLIENT_TIMEOUT", "5,60")
+    assert _timeout_from_env() == (5.0, 60.0)
+    assert Client(project="p", session=StubSession()).timeout == (5.0, 60.0)
+    monkeypatch.setenv("GORDO_TPU_CLIENT_TIMEOUT", "7")
+    assert _timeout_from_env() == (7.0, 7.0)
+    monkeypatch.setenv("GORDO_TPU_CLIENT_TIMEOUT", "bogus")
+    assert _timeout_from_env() == DEFAULT_TIMEOUT
+    # explicit constructor arg wins over env
+    assert Client(
+        project="p", session=StubSession(), timeout=3.0
+    ).timeout == (3.0, 3.0)
+
+
 def test_influx_forwarder_writes_line_protocol():
     """ForwardPredictionsIntoInflux speaks the 1.x HTTP write API directly
     (line protocol, no client library); stub session, no network."""

@@ -1,0 +1,56 @@
+"""
+Tier-1 lint gate: no bare ``except:`` in gordo_tpu/ (scripts/lint_bare_except.py).
+
+A bare except launders every exception — including KeyboardInterrupt and
+SystemExit — into one code path, which defeats the transient-vs-permanent
+classification the fault-domain layer (util/faults.py) depends on.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+LINT = REPO_ROOT / "scripts" / "lint_bare_except.py"
+
+
+def test_no_bare_except_in_gordo_tpu():
+    result = subprocess.run(
+        [sys.executable, str(LINT), "gordo_tpu"],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, (
+        f"bare 'except:' introduced:\n{result.stdout}{result.stderr}"
+    )
+
+
+def test_lint_flags_bare_except(tmp_path):
+    bad = tmp_path / "offender.py"
+    bad.write_text(
+        "try:\n    pass\nexcept:\n    pass\n"
+    )
+    result = subprocess.run(
+        [sys.executable, str(LINT), str(tmp_path)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 1
+    assert "offender.py:3" in result.stdout
+
+
+def test_lint_accepts_typed_except(tmp_path):
+    ok = tmp_path / "fine.py"
+    ok.write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n"
+        "try:\n    pass\nexcept (ValueError, KeyError) as exc:\n    raise\n"
+    )
+    result = subprocess.run(
+        [sys.executable, str(LINT), str(tmp_path)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout
