@@ -302,6 +302,24 @@ def _report_exception_and_exit(
     help="Write quarantined machines and their reasons to this JSON file "
     "in addition to stdout",
 )
+@click.option(
+    "--trace-file",
+    default=None,
+    envvar="GORDO_TPU_TRACE_FILE",
+    help="Record build telemetry spans (per-machine fetch, per-bucket "
+    "compile/train, per-machine serialize) and write them as Chrome "
+    "trace-event JSON to this path — open it in Perfetto or "
+    "chrome://tracing. Off by default: dormant spans are no-ops.",
+)
+@click.option(
+    "--metrics-file",
+    default=None,
+    envvar="GORDO_TPU_METRICS_FILE",
+    help="Write the build's telemetry metrics (phase-duration histograms, "
+    "fault-domain counters, cache effectiveness) as a Prometheus textfile "
+    "to this path — the push-style export for batch jobs scraped via the "
+    "node-exporter textfile collector.",
+)
 @_reporter_options
 def batch_build(
     config_file: str,
@@ -315,6 +333,8 @@ def batch_build(
     model_register_dir: str,
     fail_fast: bool,
     quarantine_report_file: str,
+    trace_file: str,
+    metrics_file: str,
     exceptions_reporter_file: str,
     exceptions_report_level: str,
 ):
@@ -334,6 +354,14 @@ def batch_build(
     # template wires EXCEPTIONS_REPORTER_FILE + terminationMessagePath to
     # the chunk workers too — a fleet failure must be diagnosable from the
     # k8s termination message with a stable exit code
+    from gordo_tpu.observability import telemetry
+
+    if trace_file:
+        telemetry.start_trace()
+    elif metrics_file:
+        # metrics-only collection: spans time (filling phase histograms)
+        # without growing an event buffer
+        telemetry.enable_spans()
     try:
         from gordo_tpu.parallel import BatchedModelBuilder, distributed
         from gordo_tpu.workflow.normalized_config import NormalizedConfig
@@ -384,7 +412,42 @@ def batch_build(
         _report_exception_and_exit(
             exceptions_reporter_file, exceptions_report_level
         )
+    finally:
+        # runs on every exit path, including the quarantine sys.exit above
+        # and the exception reporter's: a partially-failed build is exactly
+        # when the trace and fault counters are most wanted
+        _flush_telemetry(trace_file, metrics_file)
     return 0
+
+
+def _flush_telemetry(trace_file: str, metrics_file: str) -> None:
+    """Export the build's telemetry: refresh the XLA-cache gauges, then
+    write the Chrome trace and/or Prometheus textfile (atomic writes)."""
+    if not trace_file and not metrics_file:
+        return
+    from gordo_tpu.observability import telemetry
+    from gordo_tpu.util import xla_cache
+
+    try:
+        xla_cache.record_cache_growth()
+    except Exception:  # noqa: BLE001 — export must not mask the build result
+        logger.exception("could not refresh XLA cache metrics")
+    try:
+        if trace_file:
+            telemetry.write_trace(trace_file)
+            telemetry.stop_trace()
+            click.echo(
+                f"telemetry trace written: {trace_file} "
+                "(open in Perfetto or chrome://tracing)",
+                err=True,
+            )
+        if metrics_file:
+            telemetry.write_metrics(metrics_file)
+            click.echo(
+                f"telemetry metrics written: {metrics_file}", err=True
+            )
+    except Exception:  # noqa: BLE001 — export must not mask the build result
+        logger.exception("telemetry export failed")
 
 
 def _report_quarantine_and_exit(

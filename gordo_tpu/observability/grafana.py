@@ -336,13 +336,188 @@ def machines_dashboard() -> Dict[str, Any]:
     )
 
 
+def build_dashboard() -> Dict[str, Any]:
+    """Fleet-build telemetry dashboard over the gordo_build_* metrics the
+    telemetry spine records (observability/metrics.py) — phase durations,
+    fault-domain events, cache effectiveness, and the serving batcher's
+    queue behavior. Build metrics carry no project label (one fleet build
+    per process; textfile-exported by ``batch-build --metrics-file``), so
+    panels query unselected names."""
+    def phase_latency(q: float) -> str:
+        return (
+            f"histogram_quantile({q}, sum(rate("
+            "gordo_build_phase_seconds_bucket[5m])) by (le, phase))"
+        )
+
+    def batcher_quantile(q: float, metric: str) -> str:
+        return (
+            f"histogram_quantile({q}, sum(rate("
+            f"{metric}_bucket[5m])) by (le))"
+        )
+
+    panels = [
+        _timeseries(
+            "Build phase durations p50 / p95",
+            [
+                {"expr": phase_latency(0.5), "legend": "p50 {{phase}}"},
+                {"expr": phase_latency(0.95), "legend": "p95 {{phase}}"},
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="s",
+            description=(
+                "fetch/validate/compile/train/serialize/assemble spans from "
+                "the fleet builder; cross_validation/fit from the serial "
+                "builder"
+            ),
+        ),
+        _timeseries(
+            "Machines by outcome",
+            [
+                {
+                    "expr": "sum(gordo_build_machines_total) by (outcome)",
+                    "legend": "{{outcome}}",
+                }
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+        ),
+        _timeseries(
+            "Quarantines by stage",
+            [
+                {
+                    "expr": "sum(gordo_build_quarantines_total) by (stage)",
+                    "legend": "{{stage}}",
+                }
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+        ),
+        _timeseries(
+            "Fault-domain events",
+            [
+                {
+                    "expr": "sum(gordo_build_fault_retries_total) "
+                    "by (operation)",
+                    "legend": "retries {{operation}}",
+                },
+                {
+                    "expr": "sum(gordo_build_bucket_retries_total)",
+                    "legend": "bucket retries",
+                },
+                {
+                    "expr": "sum(gordo_build_oom_bisections_total)",
+                    "legend": "OOM bisections",
+                },
+                {
+                    "expr": "sum(gordo_build_serial_fallbacks_total) "
+                    "by (reason)",
+                    "legend": "serial fallback {{reason}}",
+                },
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            description=(
+                "The recovery ladder at work: absorbed retries, bucket "
+                "bisections, and serial last-resort builds"
+            ),
+        ),
+        _timeseries(
+            "Bucket-program cache",
+            [
+                {
+                    "expr": "sum(gordo_build_program_cache_requests_total) "
+                    "by (result)",
+                    "legend": "{{result}}",
+                },
+                {
+                    "expr": "sum(gordo_build_compile_seconds_saved_total)",
+                    "legend": "compile seconds saved",
+                },
+            ],
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "XLA cache entries",
+            "sum(gordo_build_xla_persistent_cache_entries)",
+            panel_id=6,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "XLA cache size",
+            "sum(gordo_build_xla_persistent_cache_size_bytes)",
+            panel_id=7,
+            x=_PANEL_W + 6,
+            y=2 * _PANEL_H,
+            unit="bytes",
+        ),
+        _stat(
+            "XLA cache entries added",
+            "sum(gordo_build_xla_persistent_cache_entries_added_total)",
+            panel_id=8,
+            x=_PANEL_W,
+            y=2 * _PANEL_H + 4,
+        ),
+        _timeseries(
+            "Serving batcher queue wait p50 / p95",
+            [
+                {
+                    "expr": batcher_quantile(
+                        0.5, "gordo_server_batcher_queue_wait_seconds"
+                    ),
+                    "legend": "p50",
+                },
+                {
+                    "expr": batcher_quantile(
+                        0.95, "gordo_server_batcher_queue_wait_seconds"
+                    ),
+                    "legend": "p95",
+                },
+            ],
+            panel_id=9,
+            x=0,
+            y=3 * _PANEL_H,
+            unit="s",
+        ),
+        _timeseries(
+            "Serving batcher fuse width p50 / p95",
+            [
+                {
+                    "expr": batcher_quantile(
+                        0.5, "gordo_server_batcher_fuse_width"
+                    ),
+                    "legend": "p50",
+                },
+                {
+                    "expr": batcher_quantile(
+                        0.95, "gordo_server_batcher_fuse_width"
+                    ),
+                    "legend": "p95",
+                },
+            ],
+            panel_id=10,
+            x=_PANEL_W,
+            y=3 * _PANEL_H,
+        ),
+    ]
+    return _dashboard("Gordo TPU builds", "gordo-tpu-builds", panels)
+
+
 def write_dashboards(out_dir: str) -> List[str]:
-    """Write both dashboards as JSON files into ``out_dir``; returns paths."""
+    """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
     written = []
     for name, build in (
         ("gordo_tpu_servers.json", servers_dashboard),
         ("gordo_tpu_machines.json", machines_dashboard),
+        ("gordo_tpu_build.json", build_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

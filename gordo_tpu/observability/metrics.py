@@ -1,0 +1,105 @@
+"""
+The metric catalog: every build/serve telemetry series in one place.
+
+Wiring modules (parallel/batch_trainer.py, builder/build_model.py,
+util/faults.py, util/xla_cache.py, server/batcher.py) import their series
+from here, and observability/grafana.py derives its build dashboard from
+these same objects — the names and label sets cannot drift apart silently
+(the same single-source rule the server dashboards already follow against
+server/prometheus/metrics.py). Naming contract: ``gordo_build_*`` for the
+fleet/serial build path, ``gordo_server_*`` for serving; every name is
+``gordo_``-prefixed with non-empty help (scripts/lint_metric_names.py).
+
+All series live in the telemetry default registry: process-local, no
+prometheus_client required, exported via ``batch-build --metrics-file``
+(textfile) or bridged into the server's ``/metrics``
+(telemetry.prometheus_bridge).
+"""
+
+from gordo_tpu.observability import telemetry
+
+# --------------------------------------------------------------- build path
+# span-fed phase durations; the span names in parallel/batch_trainer.py and
+# builder/build_model.py are the label values (fetch/validate/compile/train/
+# serialize/cross_validation/fit)
+BUILD_PHASE_SECONDS = telemetry.histogram(
+    "gordo_build_phase_seconds",
+    "Duration of build phases (fetch, validate, compile, train, serialize, "
+    "cross_validation, fit) across the serial and fleet builders",
+    ("phase",),
+)
+BUILD_MACHINES = telemetry.counter(
+    "gordo_build_machines_total",
+    "Machines leaving a build by outcome: built, cached (registry hit), "
+    "or quarantined",
+    ("outcome",),
+)
+FAULT_RETRIES = telemetry.counter(
+    "gordo_build_fault_retries_total",
+    "Transient-fault retries absorbed by the fault policy (util/faults.py), "
+    "by operation key",
+    ("operation",),
+)
+QUARANTINES = telemetry.counter(
+    "gordo_build_quarantines_total",
+    "Machines quarantined out of a fleet build, by stage "
+    "(data_fetch, data_validation, training, serial_build, cache)",
+    ("stage",),
+)
+OOM_BISECTIONS = telemetry.counter(
+    "gordo_build_oom_bisections_total",
+    "Bucket bisections performed after a device OOM "
+    "(each halves the machine axis of one bucket)",
+)
+BUCKET_RETRIES = telemetry.counter(
+    "gordo_build_bucket_retries_total",
+    "Whole-bucket retries after a transient training failure",
+)
+SERIAL_FALLBACKS = telemetry.counter(
+    "gordo_build_serial_fallbacks_total",
+    "Machines routed to the serial ModelBuilder, by reason "
+    "(unbatchable plan vs bucket-failure last resort)",
+    ("reason",),
+)
+PROGRAM_CACHE = telemetry.counter(
+    "gordo_build_program_cache_requests_total",
+    "In-process bucket-program (jit) cache lookups, by result (hit/miss)",
+    ("result",),
+)
+COMPILE_SECONDS_SAVED = telemetry.counter(
+    "gordo_build_compile_seconds_saved_total",
+    "Estimated compile seconds avoided by bucket-program cache hits "
+    "(each hit credits that program's measured first-compile wall)",
+)
+XLA_CACHE_ENTRIES = telemetry.gauge(
+    "gordo_build_xla_persistent_cache_entries",
+    "Entries in the persistent XLA compile cache, measured at cache setup "
+    "and again at export",
+)
+XLA_CACHE_BYTES = telemetry.gauge(
+    "gordo_build_xla_persistent_cache_size_bytes",
+    "Total size of the persistent XLA compile cache directory",
+)
+XLA_CACHE_ENTRIES_ADDED = telemetry.counter(
+    "gordo_build_xla_persistent_cache_entries_added_total",
+    "Entries the persistent XLA cache gained while this process ran "
+    "(cold compiles that future builds will skip)",
+)
+
+# ------------------------------------------------------------- serving path
+# sub-second buckets: queue waits are bounded by one fused device call
+BATCHER_QUEUE_WAIT_SECONDS = telemetry.histogram(
+    "gordo_server_batcher_queue_wait_seconds",
+    "Time a predict waited in the cross-model batcher queue before its "
+    "fused device call started",
+    buckets=(
+        0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+        0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0, float("inf"),
+    ),
+)
+BATCHER_FUSE_WIDTH = telemetry.histogram(
+    "gordo_server_batcher_fuse_width",
+    "Number of predicts fused into one device call by the cross-model "
+    "batcher",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")),
+)

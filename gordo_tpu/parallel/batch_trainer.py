@@ -67,11 +67,28 @@ from gordo_tpu.ops.train import (
     make_scanned_fit,
     n_train_samples,
 )
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.observability import telemetry
 from gordo_tpu.util import faults
 from gordo_tpu.util.faults import FaultPolicy, QuarantineRecord
 from .mesh import default_mesh, machines_sharding
 
 logger = logging.getLogger(__name__)
+
+# phase-histogram children resolved once (spans observe these on exit;
+# .labels() takes the metric lock per call)
+_PHASE_FETCH = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="fetch")
+_PHASE_VALIDATE = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="validate")
+_PHASE_COMPILE = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="compile")
+_PHASE_TRAIN = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="train")
+_PHASE_SERIALIZE = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="serialize")
+_PHASE_ASSEMBLE = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="assemble")
+
+# first-compile wall per bucket-program cache key: a later cache hit credits
+# this wall to the compile-seconds-saved counter (the measured wall includes
+# trace+lower+compile+first chunk dispatch — jit compiles synchronously on
+# the first call, execution is dispatched async, so it is compile-dominated)
+_first_compile_walls: Dict[Tuple, float] = {}
 
 
 def _machine_seed(machine: Machine) -> int:
@@ -587,13 +604,16 @@ class BatchedModelBuilder:
     # -------------------------------------------------------------- data
     def _load_data(self, plan: _Plan):
         t0 = time.time()
-        faults.fault_point("data_fetch", machine=plan.machine.name)
-        dataset = GordoBaseDataset.from_dict(plan.machine.dataset.to_dict())
-        X, y = dataset.get_data()
-        plan.X = faults.maybe_poison(
-            plan.machine.name, np.ascontiguousarray(X.to_numpy(np.float32))
-        )
-        plan.y = np.ascontiguousarray(y.to_numpy(np.float32))
+        with telemetry.span(
+            "fetch", _PHASE_FETCH, machine=plan.machine.name
+        ):
+            faults.fault_point("data_fetch", machine=plan.machine.name)
+            dataset = GordoBaseDataset.from_dict(plan.machine.dataset.to_dict())
+            X, y = dataset.get_data()
+            plan.X = faults.maybe_poison(
+                plan.machine.name, np.ascontiguousarray(X.to_numpy(np.float32))
+            )
+            plan.y = np.ascontiguousarray(y.to_numpy(np.float32))
         plan.index = X.index
         plan.columns = list(X.columns)
         plan.target_columns = list(y.columns)
@@ -648,6 +668,7 @@ class BatchedModelBuilder:
             "Machine %s QUARANTINED at %s (%s): %s",
             record.machine, record.stage, record.reason, record.error,
         )
+        faults.record_quarantine(record.stage)
         machine_out = Machine(
             name=machine.name,
             dataset=machine.dataset.to_dict(),
@@ -685,7 +706,8 @@ class BatchedModelBuilder:
         self.quarantine_records = []
         self._quarantined_names = set()
         with maybe_profile("batched-build"):
-            return self._build_all(distributed)
+            with telemetry.span("batched_build", machines=len(self.machines)):
+                return self._build_all(distributed)
 
     def _machine_output_dir(self, name: str) -> Optional[str]:
         if not self.output_dir:
@@ -732,7 +754,10 @@ class BatchedModelBuilder:
         if model_dir is None:
             return
         os.makedirs(model_dir, exist_ok=True)
-        serializer.dump(model, model_dir, metadata=machine_out.to_dict())
+        with telemetry.span(
+            "serialize", _PHASE_SERIALIZE, machine=machine_out.name
+        ):
+            serializer.dump(model, model_dir, metadata=machine_out.to_dict())
         if self.model_register_dir:
             from gordo_tpu.util import disk_registry
 
@@ -791,6 +816,7 @@ class BatchedModelBuilder:
             if i in cached_results:
                 cached = cached_results[i]
                 logger.info("Machine %s: loaded from cache", machine.name)
+                metric_catalog.BUILD_MACHINES.labels(outcome="cached").inc()
                 results[i] = cached
                 model_dir = self._machine_output_dir(machine.name)
                 if model_dir and not os.path.exists(
@@ -822,6 +848,7 @@ class BatchedModelBuilder:
             ):
                 continue
             logger.info("Machine %s: serial fallback", self.machines[i].name)
+            metric_catalog.SERIAL_FALLBACKS.labels(reason="unbatchable").inc()
             try:
                 results[i] = ModelBuilder(self.machines[i]).build(
                     output_dir=self._machine_output_dir(self.machines[i].name),
@@ -857,7 +884,10 @@ class BatchedModelBuilder:
         # would be garbage and, pre-bucketing, it is trivially isolable
         for i in list(plans):
             plan = plans[i]
-            bad = faults.non_finite_report(plan.X, plan.y)
+            with telemetry.span(
+                "validate", _PHASE_VALIDATE, machine=plan.machine.name
+            ):
+                bad = faults.non_finite_report(plan.X, plan.y)
             if bad is not None:
                 if self.fail_fast:
                     raise faults.NonFiniteDataError(
@@ -933,6 +963,7 @@ class BatchedModelBuilder:
                     "Bucket of %d machines hit device OOM (%s); bisecting "
                     "into %d + %d", len(bucket), exc, mid, len(bucket) - mid,
                 )
+                metric_catalog.OOM_BISECTIONS.inc()
                 return self._build_bucket_guarded(
                     bucket[:mid], global_idxs[:mid]
                 ) + self._build_bucket_guarded(bucket[mid:], global_idxs[mid:])
@@ -947,6 +978,7 @@ class BatchedModelBuilder:
                     len(bucket), attempt, self.fault_policy.max_attempts,
                     delay, exc,
                 )
+                metric_catalog.BUCKET_RETRIES.inc()
                 time.sleep(delay)
                 return self._build_bucket_guarded(
                     bucket, global_idxs, attempt=attempt + 1
@@ -966,6 +998,9 @@ class BatchedModelBuilder:
         also fails is quarantined, never the fleet."""
         out = []
         for i, plan in zip(global_idxs, bucket):
+            metric_catalog.SERIAL_FALLBACKS.labels(
+                reason="bucket_failure"
+            ).inc()
             try:
                 built = ModelBuilder(plan.machine).build(
                     output_dir=self._machine_output_dir(plan.machine.name),
@@ -1039,6 +1074,18 @@ class BatchedModelBuilder:
 
         multiprocess = distributed.is_multiprocess()
         sharding = machines_sharding(self.mesh)
+        program_key = (
+            spec,
+            n_rows,
+            fold_bounds,
+            plan0.epochs,
+            plan0.batch_size,
+            plan0.shuffle,
+            plan0.scale_x,
+            sharding if multiprocess else None,
+            perms is not None,
+        )
+        cache_before = _bucket_program.cache_info()
         program = _bucket_program(
             spec,
             n_rows,
@@ -1050,6 +1097,16 @@ class BatchedModelBuilder:
             out_sharding=sharding if multiprocess else None,
             use_perms=perms is not None,
         )
+        # program-cache effectiveness: a hit reuses an already-compiled
+        # program; credit its remembered first-compile wall as time saved
+        program_cached = _bucket_program.cache_info().hits > cache_before.hits
+        metric_catalog.PROGRAM_CACHE.labels(
+            result="hit" if program_cached else "miss"
+        ).inc()
+        if program_cached:
+            saved = _first_compile_walls.get(program_key)
+            if saved:
+                metric_catalog.COMPILE_SECONDS_SAVED.inc(saved)
         perms_d = None
         if perms is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -1166,15 +1223,30 @@ class BatchedModelBuilder:
         # keep at most 2 chunks in flight: dispatch chunk k+1 (async) before
         # fetching chunk k, so transfers overlap compute while peak HBM stays
         # O(chunk) rather than O(M)
+        bucket_name = f"{plan0.machine.name}+{M - 1}"
         with ThreadPoolExecutor(max_workers=8) as pool:
             starts = list(range(0, M, chunk))
-            in_flight, in_flight_start = dispatch(starts[0]), starts[0]
-            for start in starts[1:]:
-                next_in_flight = dispatch(start)
+            # jit compiles synchronously during the first call (execution is
+            # dispatched async), so the first-dispatch span is the compile
+            # span — on a warm program cache it collapses to device_put time
+            with telemetry.span(
+                "compile", _PHASE_COMPILE, bucket=bucket_name,
+                machines=M, cached=program_cached,
+            ):
+                t_compile = time.time()
+                in_flight, in_flight_start = dispatch(starts[0]), starts[0]
+                if not program_cached:
+                    _first_compile_walls[program_key] = time.time() - t_compile
+            with telemetry.span(
+                "train", _PHASE_TRAIN, bucket=bucket_name, machines=M,
+                chunk=chunk,
+            ):
+                for start in starts[1:]:
+                    next_in_flight = dispatch(start)
+                    enqueue_assembly(pool, fetch(*in_flight), in_flight_start)
+                    in_flight, in_flight_start = next_in_flight, start
                 enqueue_assembly(pool, fetch(*in_flight), in_flight_start)
-                in_flight, in_flight_start = next_in_flight, start
-            enqueue_assembly(pool, fetch(*in_flight), in_flight_start)
-            train_duration = time.time() - t0
+                train_duration = time.time() - t0
             out = [f.result() for f in futures]
         logger.info(
             "Batched bucket: %d machines (chunk %d) trained in %.2fs",
@@ -1193,6 +1265,9 @@ class BatchedModelBuilder:
             build_meta = machine_out.metadata.build_metadata.model
             build_meta.model_training_duration_sec = fit_share
             build_meta.cross_validation.cv_duration_sec = cv_share
+            phases = machine_out.metadata.build_metadata.phases
+            phases["fit"] = fit_share
+            phases["cross_validation"] = cv_share
         if self.output_dir:
             # checkpointed artifacts were written at assembly time with
             # chunk-level duration estimates — the apportionment above needs
@@ -1212,13 +1287,17 @@ class BatchedModelBuilder:
         per_machine_est: float, kfold_folds=None,
     ) -> Tuple[Any, Machine]:
         n_stages = len(fold_bounds) + 1
-        built = self._assemble(
-            plan, params, losses, fold_preds, fold_bounds,
-            per_machine_est / n_stages,
-            per_machine_est * len(fold_bounds) / n_stages,
-            kfold_folds,
-        )
+        with telemetry.span(
+            "assemble", _PHASE_ASSEMBLE, machine=plan.machine.name
+        ):
+            built = self._assemble(
+                plan, params, losses, fold_preds, fold_bounds,
+                per_machine_est / n_stages,
+                per_machine_est * len(fold_bounds) / n_stages,
+                kfold_folds,
+            )
         self._persist(plan.machine, *built)
+        metric_catalog.BUILD_MACHINES.labels(outcome="built").inc()
         return built
 
     def _assemble(
@@ -1305,6 +1384,14 @@ class BatchedModelBuilder:
                 if plan.fetch_attempts > 1
                 else {}
             ),
+            # serial-path parity (build_model.py): the batched equivalents
+            # are apportioned shares of the bucket wall, like the legacy
+            # duration fields above
+            phases={
+                "fetch": plan.query_duration,
+                "cross_validation": cv_duration,
+                "fit": train_duration,
+            },
         )
         return model, machine_out
 

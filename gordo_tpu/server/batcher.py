@@ -45,6 +45,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gordo_tpu.observability import metrics as metric_catalog
+
 logger = logging.getLogger(__name__)
 
 
@@ -58,6 +60,9 @@ class _Item:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[np.ndarray] = None
     error: Optional[BaseException] = None
+    # monotonic submit time: queue-wait = device-call start - submit
+    # (gordo_server_batcher_queue_wait_seconds)
+    t_submit: float = 0.0
 
 
 @functools.lru_cache(maxsize=256)
@@ -345,6 +350,7 @@ class CrossModelBatcher:
 
         X_pad, n_pad, n_keep = pad_for_predict(spec, X)
         item = _Item(spec, params, X_pad, n_pad, n_keep)
+        item.t_submit = time.monotonic()
         self._ensure_thread()
         self._q.put(item)
         if not item.done.wait(timeout=self.timeout_s):
@@ -405,6 +411,15 @@ class CrossModelBatcher:
 
     def _run_group(self, spec, items: List[_Item]):
         n = len(items)
+        # telemetry histograms (process-local, no prometheus_client needed;
+        # bridged into /metrics by server/prometheus/metrics.py): how long
+        # each predict queued before this fused call, and the fuse width
+        now = time.monotonic()
+        for item in items:
+            metric_catalog.BATCHER_QUEUE_WAIT_SECONDS.observe(
+                max(0.0, now - item.t_submit)
+            )
+        metric_catalog.BATCHER_FUSE_WIDTH.observe(n)
         # few fixed batch buckets per (spec, shape): every new bucket is a
         # fresh XLA compile at serving time (measured as multi-second p95
         # spikes in the A/B bench). Buckets grow 4x so padding waste stays

@@ -61,6 +61,18 @@ class GordoServerPrometheusMetrics:
     ):
         self.project = project or "unknown"
         self.registry = registry if registry is not None else create_registry()
+        # bridge the dependency-light telemetry registry (batcher queue-wait
+        # and fuse-width histograms, any build metrics recorded in-process)
+        # into the exposition registry. Values are read live at scrape time.
+        # Guarded so a shared registry across app rebuilds (tests) doesn't
+        # accumulate duplicate collectors; in multiprocess mode the bridged
+        # series are the scraped worker's own — process-local by design,
+        # unlike the mmap-backed aggregates above.
+        if not getattr(self.registry, "_gordo_telemetry_bridged", False):
+            from gordo_tpu.observability import telemetry
+
+            telemetry.prometheus_bridge(self.registry)
+            self.registry._gordo_telemetry_bridged = True
         # In multiprocess mode the exposition registry must contain ONLY the
         # MultiProcessCollector (it reads every worker's mmap files);
         # registering the live metric objects there too would double-count.

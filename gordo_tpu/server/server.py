@@ -13,6 +13,7 @@ werkzeug server (model inference is released-GIL device compute, so threads
 scale; multiple processes can still be run behind any WSGI server).
 """
 
+import contextlib
 import json
 import logging
 import os
@@ -115,6 +116,25 @@ class RequestContext:
         self.collection_dir: Optional[str] = None
         self.current_revision: Optional[str] = None
         self.revision: Optional[str] = None
+        # per-phase durations (seconds) recorded by the view handlers via
+        # phase(); rendered into the response's Server-Timing header
+        self.timings: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time one request phase (decode/predict/encode). Repeated phases
+        accumulate. Doubles as a telemetry span, so a traced server process
+        shows per-request phases on the same timeline as device work."""
+        from gordo_tpu.observability import telemetry
+
+        with telemetry.span(f"serve_{name}"):
+            t0 = timeit.default_timer()
+            try:
+                yield
+            finally:
+                self.timings[name] = self.timings.get(name, 0.0) + (
+                    timeit.default_timer() - t0
+                )
 
 
 class GordoServer:
@@ -371,8 +391,18 @@ class GordoServer:
                     mimetype="application/json",
                 )
 
+        # Server-Timing: the reference's single request_walltime_s entry
+        # (kept first, same name/unit, for client parity) plus a per-phase
+        # breakdown recorded by the views (decode/predict/encode — where a
+        # prediction request's time actually went). Seconds throughout,
+        # marked by the _s suffix (the reference already broke the spec's
+        # milliseconds convention; consistency wins over mixing units).
         runtime_s = timeit.default_timer() - ctx.start_time
-        response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        entries = [f"request_walltime_s;dur={runtime_s}"]
+        entries.extend(
+            f"{name}_s;dur={duration}" for name, duration in ctx.timings.items()
+        )
+        response.headers["Server-Timing"] = ", ".join(entries)
         if ctx.revision:
             response.headers["revision"] = ctx.revision
         return response

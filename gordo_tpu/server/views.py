@@ -132,14 +132,16 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
     mc = ModelContext(ctx, gordo_name)
     mc.model  # force 404 before payload parsing
     try:
-        X, y = extract_X_y(request, mc)
+        with ctx.phase("decode"):
+            X, y = extract_X_y(request, mc)
     except (server_utils.BadDataFrame, ValueError) as exc:
         return json_response(ctx, {"message": str(exc)}, 400)
 
     context: dict = {}
     start = timeit.default_timer()
     try:
-        output = model_io.get_model_output(model=mc.model, X=X)
+        with ctx.phase("predict"):
+            output = model_io.get_model_output(model=mc.model, X=X)
     except ValueError as err:
         logger.error("Failed to predict: %s\n%s", err, traceback.format_exc())
         context["error"] = f"ValueError: {str(err)}"
@@ -149,22 +151,23 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
         context["error"] = "Something unexpected happened; check your input data"
         return json_response(ctx, context, 400)
 
-    data = model_utils.make_base_dataframe(
-        tags=mc.tags,
-        model_input=X.values if isinstance(X, pd.DataFrame) else X,
-        model_output=output,
-        target_tag_list=mc.target_tags,
-        index=X.index,
-        # the model's resolution: without it every 'end' timestamp would be
-        # null (the anomaly route already passes it)
-        frequency=mc.frequency,
-    )
-    if request.args.get("format") == "parquet":
-        return Response(
-            server_utils.dataframe_into_parquet_bytes(data),
-            mimetype="application/octet-stream",
+    with ctx.phase("encode"):
+        data = model_utils.make_base_dataframe(
+            tags=mc.tags,
+            model_input=X.values if isinstance(X, pd.DataFrame) else X,
+            model_output=output,
+            target_tag_list=mc.target_tags,
+            index=X.index,
+            # the model's resolution: without it every 'end' timestamp would
+            # be null (the anomaly route already passes it)
+            frequency=mc.frequency,
         )
-    context["data"] = server_utils.dataframe_to_dict(data)
+        if request.args.get("format") == "parquet":
+            return Response(
+                server_utils.dataframe_into_parquet_bytes(data),
+                mimetype="application/octet-stream",
+            )
+        context["data"] = server_utils.dataframe_to_dict(data)
     context["time-seconds"] = f"{timeit.default_timer() - start:.4f}"
     return json_response(ctx, context, 200)
 
@@ -183,7 +186,8 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
         )
 
     try:
-        X, y = extract_X_y(request, mc)
+        with ctx.phase("decode"):
+            X, y = extract_X_y(request, mc)
     except (server_utils.BadDataFrame, ValueError) as exc:
         return json_response(ctx, {"message": str(exc)}, 400)
 
@@ -193,7 +197,8 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
         )
 
     try:
-        anomaly_df = mc.model.anomaly(X, y, frequency=mc.frequency)
+        with ctx.phase("predict"):
+            anomaly_df = mc.model.anomaly(X, y, frequency=mc.frequency)
     except AttributeError as exc:
         return json_response(
             ctx,
@@ -203,23 +208,24 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
             422,
         )
 
-    if request.args.get("all_columns") is None:
-        drop = [
-            c
-            for c in anomaly_df.columns.get_level_values(0).unique()
-            if c in DELETED_FROM_RESPONSE_COLUMNS
-        ]
-        anomaly_df = anomaly_df.drop(columns=drop, level=0)
+    with ctx.phase("encode"):
+        if request.args.get("all_columns") is None:
+            drop = [
+                c
+                for c in anomaly_df.columns.get_level_values(0).unique()
+                if c in DELETED_FROM_RESPONSE_COLUMNS
+            ]
+            anomaly_df = anomaly_df.drop(columns=drop, level=0)
 
-    if request.args.get("format") == "parquet":
-        return Response(
-            server_utils.dataframe_into_parquet_bytes(anomaly_df),
-            mimetype="application/octet-stream",
-        )
-    context = {
-        "data": server_utils.dataframe_to_dict(anomaly_df),
-        "time-seconds": f"{timeit.default_timer() - start_time:.4f}",
-    }
+        if request.args.get("format") == "parquet":
+            return Response(
+                server_utils.dataframe_into_parquet_bytes(anomaly_df),
+                mimetype="application/octet-stream",
+            )
+        context = {
+            "data": server_utils.dataframe_to_dict(anomaly_df),
+            "time-seconds": f"{timeit.default_timer() - start_time:.4f}",
+        }
     return json_response(ctx, context, 200)
 
 

@@ -200,6 +200,29 @@ class FaultPolicy:
         return delay
 
 
+def record_retry(operation: str) -> None:
+    """Count one absorbed transient retry in the telemetry registry
+    (observability/metrics.py). Guarded: the fault path must survive even
+    a broken observability layer."""
+    try:
+        from gordo_tpu.observability import metrics as metric_catalog
+
+        metric_catalog.FAULT_RETRIES.labels(operation=operation).inc()
+    except Exception:  # noqa: BLE001 — metrics must never mask the fault
+        logger.debug("could not record retry metric", exc_info=True)
+
+
+def record_quarantine(stage: str) -> None:
+    """Count one quarantined machine by stage (same guard rationale)."""
+    try:
+        from gordo_tpu.observability import metrics as metric_catalog
+
+        metric_catalog.QUARANTINES.labels(stage=stage).inc()
+        metric_catalog.BUILD_MACHINES.labels(outcome="quarantined").inc()
+    except Exception:  # noqa: BLE001 — metrics must never mask the fault
+        logger.debug("could not record quarantine metric", exc_info=True)
+
+
 def retry_call(
     fn,
     policy: FaultPolicy,
@@ -223,6 +246,7 @@ def retry_call(
                 "%s failed transiently (attempt %d/%d, retrying in %.2fs): %s",
                 describe, attempt, policy.max_attempts, delay, exc,
             )
+            record_retry(describe.split(" for ", 1)[0].replace(" ", "_"))
             sleep(delay)
 
 

@@ -39,8 +39,23 @@ def maybe_profile(label: str):
     logger.info("profile written: %s (open with TensorBoard/Perfetto)", target)
 
 
+def profiling_enabled() -> bool:
+    """Whether $GORDO_TPU_PROFILE_DIR device profiling is requested."""
+    return bool(os.environ.get(PROFILE_DIR_ENV))
+
+
 def annotate(name: str):
-    """Named sub-span inside an active trace (no-op when not tracing)."""
+    """Named sub-span inside an active device trace.
+
+    A true no-op (shared ``nullcontext``) unless ``$GORDO_TPU_PROFILE_DIR``
+    is set: the previous version imported jax and built a
+    ``TraceAnnotation`` unconditionally, paying object churn (and a
+    possible first jax import) on paths that were not being traced at all.
+    Telemetry spans (observability/telemetry.py) route through this, so
+    device-op timelines and telemetry spans share names when both are on.
+    """
+    if not profiling_enabled():
+        return contextlib.nullcontext()
     import jax
 
     return jax.profiler.TraceAnnotation(name)

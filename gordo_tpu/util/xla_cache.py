@@ -1,8 +1,57 @@
 """Persistent XLA compilation cache setup, shared by bench.py and serving
 warmup — one copy of the directory scheme so their compiles land in (and
-re-use) the same cache."""
+re-use) the same cache.
+
+Effectiveness is observable: :func:`setup_persistent_xla_cache` records the
+cache's entry count and byte size at startup into the telemetry registry
+(observability/metrics.py), and :func:`record_cache_growth` re-measures at
+export time — entries gained during the process are cold compiles that
+future builds will skip."""
 
 import os
+from typing import Optional, Tuple
+
+# entry count at setup, so record_cache_growth can report the delta
+_entries_at_setup: Optional[int] = None
+_cache_dir: Optional[str] = None
+
+
+def cache_stats(cache_dir: str) -> Tuple[int, int]:
+    """(entry_count, total_bytes) of a persistent-cache directory; (0, 0)
+    when it does not exist yet (jax creates it on first persisted compile)."""
+    entries = 0
+    total_bytes = 0
+    try:
+        with os.scandir(cache_dir) as it:
+            for entry in it:
+                if not entry.is_file(follow_symlinks=False):
+                    continue
+                entries += 1
+                try:
+                    total_bytes += entry.stat(follow_symlinks=False).st_size
+                except OSError:
+                    pass
+    except OSError:
+        return 0, 0
+    return entries, total_bytes
+
+
+def record_cache_growth() -> Tuple[int, int]:
+    """Refresh the cache gauges and credit entries added since the last
+    measurement to the added-entries counter (the high-water mark advances,
+    so repeated calls never double-count). Returns (entries, bytes)."""
+    global _entries_at_setup
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    if _cache_dir is None:
+        return 0, 0
+    entries, size = cache_stats(_cache_dir)
+    metric_catalog.XLA_CACHE_ENTRIES.set(entries)
+    metric_catalog.XLA_CACHE_BYTES.set(size)
+    if _entries_at_setup is not None and entries > _entries_at_setup:
+        metric_catalog.XLA_CACHE_ENTRIES_ADDED.inc(entries - _entries_at_setup)
+        _entries_at_setup = entries
+    return entries, size
 
 
 def host_fingerprint() -> str:
@@ -50,6 +99,7 @@ def setup_persistent_xla_cache(min_compile_secs: float = 1.0) -> str:
     feature-mismatch warnings from exactly that). Failures are swallowed
     (the cache is an optimization only). Returns the dir used.
     """
+    global _entries_at_setup, _cache_dir
     import jax
 
     cache_dir = os.environ.get(
@@ -64,5 +114,18 @@ def setup_persistent_xla_cache(min_compile_secs: float = 1.0) -> str:
             "jax_persistent_cache_min_compile_time_secs", min_compile_secs
         )
     except Exception:  # noqa: BLE001
+        pass
+    # startup snapshot of cache effectiveness (warm entries available to
+    # this process); export-time record_cache_growth() reports what was
+    # added. Gauges are cheap and the scan is one directory listing.
+    try:
+        from gordo_tpu.observability import metrics as metric_catalog
+
+        _cache_dir = cache_dir
+        entries, size = cache_stats(cache_dir)
+        _entries_at_setup = entries
+        metric_catalog.XLA_CACHE_ENTRIES.set(entries)
+        metric_catalog.XLA_CACHE_BYTES.set(size)
+    except Exception:  # noqa: BLE001 — observability must not break setup
         pass
     return cache_dir

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""
+Lint: every metric registered under gordo_tpu/ must carry a ``gordo_``
+prefix and non-empty help text.
+
+Prometheus metric names are a public, append-only API: dashboards
+(observability/grafana.py), alert rules, and recording rules key on them.
+An unprefixed name collides with other exporters on the same host, and an
+empty help string makes /metrics and textfile exports undocumented at
+exactly the place operators read them. Same enforcement pattern as the
+PR 1 bare-except lint (scripts/lint_bare_except.py).
+
+Checked call shapes: any call to ``Counter``/``Gauge``/``Histogram``
+(prometheus_client or telemetry classes) or the telemetry factory functions
+``counter``/``gauge``/``histogram`` whose metric name is a string literal.
+Calls whose name argument is a variable (the telemetry registry's own
+internals) are skipped — the registry validates help text at runtime.
+
+Usage: ``python scripts/lint_metric_names.py [root ...]`` (default:
+``gordo_tpu``). Exit 0 = clean, 1 = violations (printed one per line),
+2 = a file failed to parse. Wired into tier-1 via
+tests/gordo_tpu/test_lint.py.
+"""
+
+import ast
+import pathlib
+import sys
+from typing import List, Optional
+
+_FACTORY_NAMES = {
+    "Counter", "Gauge", "Histogram", "Summary",
+    "counter", "gauge", "histogram",
+}
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _string_literal(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _argument(node: ast.Call, position: int, *keywords: str):
+    """The argument at ``position`` or under any of ``keywords``; None when
+    absent."""
+    if len(node.args) > position:
+        return node.args[position]
+    for kw in node.keywords:
+        if kw.arg in keywords:
+            return kw.value
+    return None
+
+
+def find_bad_metrics(root: str) -> List[str]:
+    violations = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) not in _FACTORY_NAMES:
+                continue
+            name = _string_literal(_argument(node, 0, "name"))
+            if name is None:
+                # name is a variable/expression (e.g. the registry's own
+                # get-or-create plumbing): nothing checkable here
+                continue
+            where = f"{path}:{node.lineno}"
+            if not name.startswith("gordo_"):
+                violations.append(
+                    f"{where}: metric {name!r} must carry the 'gordo_' "
+                    f"prefix (dashboards and alerts key on the namespace)"
+                )
+            help_node = _argument(node, 1, "help", "documentation")
+            help_text = _string_literal(help_node)
+            if help_node is None or (
+                help_text is not None and not help_text.strip()
+            ):
+                violations.append(
+                    f"{where}: metric {name!r} must carry non-empty help "
+                    f"text (/metrics and textfile exports are the operator "
+                    f"docs)"
+                )
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    roots = argv or ["gordo_tpu"]
+    violations = []
+    for root in roots:
+        try:
+            violations.extend(find_bad_metrics(root))
+        except SyntaxError as exc:
+            print(f"parse error: {exc}", file=sys.stderr)
+            return 2
+    for line in violations:
+        print(line)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

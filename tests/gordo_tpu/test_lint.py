@@ -1,9 +1,13 @@
 """
-Tier-1 lint gate: no bare ``except:`` in gordo_tpu/ (scripts/lint_bare_except.py).
+Tier-1 lint gates.
 
-A bare except launders every exception — including KeyboardInterrupt and
-SystemExit — into one code path, which defeats the transient-vs-permanent
-classification the fault-domain layer (util/faults.py) depends on.
+- No bare ``except:`` in gordo_tpu/ (scripts/lint_bare_except.py): a bare
+  except launders every exception — including KeyboardInterrupt and
+  SystemExit — into one code path, which defeats the transient-vs-permanent
+  classification the fault-domain layer (util/faults.py) depends on.
+- Every registered metric carries a ``gordo_`` prefix and non-empty help
+  text (scripts/lint_metric_names.py): metric names are a public API for
+  dashboards and alerts; help strings are the operator docs at /metrics.
 """
 
 import pathlib
@@ -12,6 +16,7 @@ import sys
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 LINT = REPO_ROOT / "scripts" / "lint_bare_except.py"
+METRIC_LINT = REPO_ROOT / "scripts" / "lint_metric_names.py"
 
 
 def test_no_bare_except_in_gordo_tpu():
@@ -53,4 +58,55 @@ def test_lint_accepts_typed_except(tmp_path):
         capture_output=True,
         text=True,
     )
+    assert result.returncode == 0, result.stdout
+
+
+# ------------------------------------------------------ metric-name lint
+def _run_metric_lint(root):
+    return subprocess.run(
+        [sys.executable, str(METRIC_LINT), str(root)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_no_bad_metric_names_in_gordo_tpu():
+    result = _run_metric_lint("gordo_tpu")
+    assert result.returncode == 0, (
+        f"bad metric registration introduced:\n{result.stdout}{result.stderr}"
+    )
+
+
+def test_metric_lint_flags_missing_prefix_and_help(tmp_path):
+    bad = tmp_path / "offender.py"
+    bad.write_text(
+        "from prometheus_client import Counter, Histogram\n"
+        'c = Counter("requests_total", "has help but no prefix")\n'
+        'h = Histogram("gordo_good_name_seconds", "")\n'
+        "from gordo_tpu.observability import telemetry\n"
+        'g = telemetry.gauge("gordo_no_help_at_all")\n'
+    )
+    result = _run_metric_lint(tmp_path)
+    assert result.returncode == 1
+    assert "offender.py:2" in result.stdout and "prefix" in result.stdout
+    assert "offender.py:3" in result.stdout and "help" in result.stdout
+    assert "offender.py:5" in result.stdout
+
+
+def test_metric_lint_accepts_prefixed_documented_metrics(tmp_path):
+    ok = tmp_path / "fine.py"
+    ok.write_text(
+        "from prometheus_client import Counter\n"
+        'c = Counter("gordo_things_total", "things that happened", ["kind"])\n'
+        "from gordo_tpu.observability import telemetry\n"
+        'h = telemetry.histogram(\n'
+        '    name="gordo_thing_seconds", help="how long things took"\n'
+        ")\n"
+        "# variable names are unlintable and skipped (registry internals)\n"
+        "name = 'dynamic'\n"
+        "import collections\n"
+        "counts = collections.Counter([1, 2, 2])\n"
+    )
+    result = _run_metric_lint(tmp_path)
     assert result.returncode == 0, result.stdout

@@ -4,11 +4,16 @@ import json
 import re
 
 from gordo_tpu.observability import (
+    build_dashboard,
     machines_dashboard,
     servers_dashboard,
+    telemetry,
     write_dashboards,
 )
+from gordo_tpu.observability import metrics as metric_catalog  # noqa: F401
 from gordo_tpu.server.prometheus import metrics as server_metrics
+
+_ALL_DASHBOARDS = (servers_dashboard, machines_dashboard, build_dashboard)
 
 
 def _all_exprs(dash):
@@ -18,8 +23,11 @@ def _all_exprs(dash):
 
 
 def test_dashboards_reference_live_metric_names():
-    """Every metric a dashboard queries must be one the server exports,
-    so the dashboards can't silently drift from the metrics module."""
+    """Every metric a dashboard queries must be one the system exports —
+    either a prometheus_client metric the server registers
+    (server/prometheus/metrics.py) or a telemetry-registry series from the
+    catalog (observability/metrics.py) — so dashboards can't silently
+    drift from the metrics modules."""
     exported = {
         "gordo_server_request_duration_seconds",
         "gordo_server_requests_total",
@@ -33,11 +41,14 @@ def test_dashboards_reference_live_metric_names():
     src = open(server_metrics.__file__).read()
     for name in exported:
         assert f'"{name}"' in src, name
+    # plus every series registered through the telemetry catalog (importing
+    # it above registered them in the default registry)
+    exported |= set(telemetry.default_registry().names())
 
     suffix = r"(?:_bucket|_count|_sum)?"
-    metric_re = re.compile(r"(gordo_server_[a-z_]+?)" + suffix + r"\{")
-    for dash in (servers_dashboard(), machines_dashboard()):
-        for expr in _all_exprs(dash):
+    metric_re = re.compile(r"(gordo_(?:server|build)_[a-z_]+?)" + suffix + r"[{\[\s)]")
+    for dashboard in _ALL_DASHBOARDS:
+        for expr in _all_exprs(dashboard()):
             names = metric_re.findall(expr)
             assert names, expr
             for name in names:
@@ -46,7 +57,8 @@ def test_dashboards_reference_live_metric_names():
 
 
 def test_dashboard_structure():
-    for dash in (servers_dashboard(), machines_dashboard()):
+    for dashboard in _ALL_DASHBOARDS:
+        dash = dashboard()
         ids = [p["id"] for p in dash["panels"]]
         assert len(ids) == len(set(ids))
         assert dash["uid"]
@@ -59,18 +71,19 @@ def test_dashboard_structure():
 
 
 def test_latency_panels_use_quantiles_not_averages():
-    dash = servers_dashboard()
-    latency_exprs = [
-        e for e in _all_exprs(dash) if "request_duration_seconds_bucket" in e
-    ]
-    assert latency_exprs
-    for expr in latency_exprs:
-        assert "histogram_quantile" in expr
+    for dashboard in (servers_dashboard, build_dashboard):
+        dash = dashboard()
+        latency_exprs = [
+            e for e in _all_exprs(dash) if "_seconds_bucket" in e
+        ]
+        assert latency_exprs
+        for expr in latency_exprs:
+            assert "histogram_quantile" in expr
 
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 2
+    assert len(paths) == 3
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
@@ -88,6 +101,7 @@ def test_checked_in_dashboards_are_current():
     for name, build in (
         ("gordo_tpu_servers.json", servers_dashboard),
         ("gordo_tpu_machines.json", machines_dashboard),
+        ("gordo_tpu_build.json", build_dashboard),
     ):
         with open(os.path.join(out_dir, name)) as fh:
             assert json.load(fh) == build(), f"{name} is stale — regenerate with " \

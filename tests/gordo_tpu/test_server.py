@@ -24,9 +24,29 @@ def client(app):
     return app.test_client()
 
 
+def _assert_server_timing(resp, phased: bool):
+    """Server-Timing contract: the reference-parity walltime entry first,
+    plus the decode/predict/encode breakdown on prediction routes. Every
+    entry is `name;dur=<float seconds>`."""
+    header = resp.headers["Server-Timing"]
+    entries = {}
+    for raw in header.split(","):
+        name, _, dur = raw.strip().partition(";dur=")
+        entries[name] = float(dur)  # malformed dur would raise here
+    assert "request_walltime_s" in entries
+    if phased:
+        for phase in ("decode_s", "predict_s", "encode_s"):
+            assert phase in entries, header
+            assert 0.0 <= entries[phase] <= entries["request_walltime_s"]
+    return entries
+
+
 def test_healthcheck(client):
     resp = client.get("/healthcheck")
     assert resp.status_code == 200
+    # non-prediction routes keep the single reference-parity entry
+    entries = _assert_server_timing(resp, phased=False)
+    assert set(entries) == {"request_walltime_s"}
 
 
 def test_server_version(client):
@@ -86,6 +106,7 @@ def test_prediction_json(client, gordo_project, gordo_name, X_payload):
     assert "data" in body
     assert "model-output" in body["data"]
     assert body["revision"]
+    _assert_server_timing(resp, phased=True)
 
 
 def test_prediction_missing_X_400(client, gordo_project, gordo_name):
@@ -118,6 +139,7 @@ def test_anomaly_json(client, gordo_project, gordo_name, X_payload):
     assert "tag-anomaly-scaled" in data
     # smoothed columns dropped by default
     assert not any(k.startswith("smooth-") for k in data)
+    _assert_server_timing(resp, phased=True)
 
 
 def test_anomaly_all_columns(
