@@ -103,3 +103,65 @@ BATCHER_FUSE_WIDTH = telemetry.histogram(
     "batcher",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")),
 )
+
+# ------------------------------------------------- serving resilience (PR 3)
+# wired by server/resilience.py, server/server.py, server/views.py,
+# server/batcher.py, server/utils.py
+SERVER_SHED = telemetry.counter(
+    "gordo_server_shed_total",
+    "Requests shed by admission control (503 + Retry-After) instead of "
+    "queueing behind a saturated device, by reason",
+    ("reason",),
+)
+SERVER_DEADLINE_EXCEEDED = telemetry.counter(
+    "gordo_server_deadline_exceeded_total",
+    "Requests that exhausted their deadline budget "
+    "(X-Gordo-Deadline-Ms / GORDO_TPU_DEADLINE_MS), by where the budget "
+    "ran out (preflight, queue_wait)",
+    ("where",),
+)
+BATCHER_ABANDONED = telemetry.counter(
+    "gordo_server_batcher_abandoned_total",
+    "Batched predicts whose waiter gave up (timeout or deadline) before "
+    "the fused device call fanned results out; abandoned items still "
+    "queued are skipped at fan-out instead of computed for nobody",
+)
+BREAKER_STATE = telemetry.gauge(
+    "gordo_server_breaker_state",
+    "Per-model circuit-breaker state: 0=closed, 1=half-open, 2=open",
+    ("model",),
+)
+BREAKER_OPENS = telemetry.counter(
+    "gordo_server_breaker_opens_total",
+    "Circuit-breaker open transitions per model (consecutive predict/load "
+    "failures crossed the threshold, or a permanent-class fault)",
+    ("model",),
+)
+BREAKER_FAST_FAILURES = telemetry.counter(
+    "gordo_server_breaker_fast_failures_total",
+    "Requests answered by an open circuit breaker (fast 503 naming the "
+    "model) without touching the model",
+    ("model",),
+)
+GROUP_BISECTIONS = telemetry.counter(
+    "gordo_server_group_bisections_total",
+    "Fused-group device-call failures answered by bisecting the batch and "
+    "retrying the halves (serving twin of the build-side OOM bisection)",
+)
+GROUP_SERIAL_RESCUES = telemetry.counter(
+    "gordo_server_group_serial_rescues_total",
+    "Single predicts retried through the serial (un-fused) program after "
+    "their fused group failed — the last rung of the serving ladder",
+)
+WATCHDOG_TRIPS = telemetry.counter(
+    "gordo_server_watchdog_trips_total",
+    "Healthcheck probes answered 503 because the batcher dispatcher has "
+    "been stuck in one device call past GORDO_TPU_WATCHDOG_S",
+)
+MODEL_LOAD_FAILURES = telemetry.counter(
+    "gordo_server_model_load_failures_total",
+    "Model-load failures in the serving path, by kind: fresh (a real "
+    "deserialize attempt failed, now negative-cached) or cached (the "
+    "TTL'd negative cache answered without re-reading the artifact)",
+    ("kind",),
+)

@@ -63,6 +63,12 @@ class _Item:
     # monotonic submit time: queue-wait = device-call start - submit
     # (gordo_server_batcher_queue_wait_seconds)
     t_submit: float = 0.0
+    # the serving model's name (resilience request scope) — fault-plan
+    # matching and abandoned-item logging; "" outside a request
+    tag: str = ""
+    # set by the waiter when its timeout/deadline expires: the dispatcher
+    # skips abandoned items at fan-out instead of computing for nobody
+    abandoned: bool = False
 
 
 @functools.lru_cache(maxsize=256)
@@ -95,6 +101,35 @@ def _stacked_apply(spec, n_pad: int, batch: int, capacity: int):
         return jax.vmap(one)(params, X)
 
     return jax.jit(gathered)
+
+
+@functools.lru_cache(maxsize=256)
+def _single_apply(spec, n_pad: int):
+    """Un-fused single-model program: the serial rescue rung of the fused
+    group's fault-isolation ladder. Deliberately bypasses the param bank
+    and the gather program — when those are what broke, the rescue must
+    not share their fate."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_tpu.ops.nn import apply_model
+
+    if spec.lookback_window <= 1 and spec.lookahead == 0:
+
+        def one(params, X):
+            out, _ = apply_model(spec, params, X)
+            return out
+
+    else:
+
+        def one(params, X):
+            idx = jnp.arange(n_pad)
+            window = jnp.arange(spec.lookback_window)
+            xb = X[idx[:, None] + window[None, :]]
+            out, _ = apply_model(spec, params, xb)
+            return out
+
+    return jax.jit(one)
 
 
 class _ParamBank:
@@ -193,9 +228,15 @@ class CrossModelBatcher:
         self.self_ab = self_ab
         self._spec_on: Dict[Any, bool] = {}
         self._calibrating: set = set()
+        # (spec, shape) pairs whose abandonment has been logged already
+        self._abandon_logged: set = set()
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
+        # monotonic start of the device call the dispatcher is currently
+        # inside (None between calls): the device-watchdog signal
+        # (resilience.stuck_device_call_s -> /healthcheck 503)
+        self._busy_since: Optional[float] = None
 
     # ------------------------------------------------------------- public
     def decision_counts(self) -> Tuple[int, int]:
@@ -204,6 +245,12 @@ class CrossModelBatcher:
         decisions = list(self._spec_on.values())
         on = sum(1 for d in decisions if d)
         return on, len(decisions) - on
+
+    def device_call_stuck_s(self) -> float:
+        """Seconds the dispatcher has been inside its current device call
+        (0.0 between calls) — read by the device watchdog."""
+        t0 = self._busy_since
+        return 0.0 if t0 is None else max(0.0, time.monotonic() - t0)
 
     def submit(self, spec, params, X) -> Optional[np.ndarray]:
         """Blocking predict through the batch queue (thread-safe).
@@ -345,21 +392,65 @@ class CrossModelBatcher:
         return won
 
     def _force_submit(self, spec, params, X) -> np.ndarray:
-        """submit() minus the auto-mode gate (used by calibration)."""
+        """submit() minus the auto-mode gate (used by calibration).
+
+        The wait honors both the batcher's own timeout and the request's
+        deadline budget (resilience.request_scope) — queue-wait counts
+        against the budget. A waiter that gives up marks its item
+        *abandoned*: the dispatcher skips it at fan-out instead of
+        computing a result nobody is waiting for."""
         from gordo_tpu.ops.train import pad_for_predict
+        from gordo_tpu.server import resilience
 
         X_pad, n_pad, n_keep = pad_for_predict(spec, X)
         item = _Item(spec, params, X_pad, n_pad, n_keep)
         item.t_submit = time.monotonic()
+        item.tag = resilience.current_model() or ""
+        # budget already spent (e.g. decode ate it): never even queue
+        resilience.check_deadline("queue_wait")
+        remaining = resilience.remaining_s()
+        timeout = self.timeout_s
+        deadline_bound = False
+        if remaining is not None and (timeout is None or remaining < timeout):
+            timeout = remaining
+            deadline_bound = True
         self._ensure_thread()
         self._q.put(item)
-        if not item.done.wait(timeout=self.timeout_s):
+        if not item.done.wait(timeout=timeout):
+            item.abandoned = True
+            self._record_abandoned(item)
+            if deadline_bound:
+                resilience.record_deadline_exceeded("queue_wait")
+                raise resilience.DeadlineExceeded(
+                    f"batched predict abandoned: request deadline "
+                    f"({timeout * 1e3:.0f}ms remaining at submit) expired "
+                    f"in the batch queue"
+                )
             raise TimeoutError(
-                f"batched predict timed out after {self.timeout_s:.0f}s"
+                f"batched predict timed out after {timeout:.0f}s"
             )
         if item.error is not None:
             raise item.error
         return item.result
+
+    def _record_abandoned(self, item: _Item) -> None:
+        """Count one abandoned item; log its spec/shape once per (spec,
+        shape) so a recurring wedge is diagnosable without a log flood."""
+        metric_catalog.BATCHER_ABANDONED.inc()
+        key = (item.spec, item.X_pad.shape)
+        with self._lock:
+            if key in self._abandon_logged:
+                return
+            self._abandon_logged.add(key)
+        arch = "/".join(
+            sorted({type(layer).__name__ for layer in item.spec.layers})
+        )
+        logger.warning(
+            "batched predict abandoned by its waiter (model %r, arch %s, "
+            "padded shape %s); further abandons for this (spec, shape) "
+            "are counted but not logged",
+            item.tag or "?", arch or "?", item.X_pad.shape,
+        )
 
     # ------------------------------------------------------------ worker
     def _ensure_thread(self):
@@ -410,7 +501,6 @@ class CrossModelBatcher:
                     item.done.set()
 
     def _run_group(self, spec, items: List[_Item]):
-        n = len(items)
         # telemetry histograms (process-local, no prometheus_client needed;
         # bridged into /metrics by server/prometheus/metrics.py): how long
         # each predict queued before this fused call, and the fuse width
@@ -419,7 +509,40 @@ class CrossModelBatcher:
             metric_catalog.BATCHER_QUEUE_WAIT_SECONDS.observe(
                 max(0.0, now - item.t_submit)
             )
-        metric_catalog.BATCHER_FUSE_WIDTH.observe(n)
+        metric_catalog.BATCHER_FUSE_WIDTH.observe(len(items))
+        self._execute(spec, items)
+
+    def _execute(self, spec, items: List[_Item]):
+        """The serving twin of the build side's recovery ladder: run the
+        fused call; on failure bisect and retry the halves, bottoming out
+        in a serial (un-fused) rescue per item — one poisoned submission
+        degrades only itself, never its cohort."""
+        try:
+            self._device_call(spec, items)
+        except BaseException as exc:  # noqa: BLE001 — ladder, then fan out
+            if len(items) == 1:
+                self._serial_rescue(spec, items[0], exc)
+                return
+            metric_catalog.GROUP_BISECTIONS.inc()
+            logger.warning(
+                "fused device call over %d predicts failed (%s: %s); "
+                "bisecting", len(items), type(exc).__name__, exc,
+            )
+            mid = len(items) // 2
+            self._execute(spec, items[:mid])
+            self._execute(spec, items[mid:])
+
+    def _device_call(self, spec, items: List[_Item]):
+        from gordo_tpu.server import resilience
+        from gordo_tpu.util import faults
+
+        # a waiter that timed out while these queued is gone: computing
+        # its lane would be work for nobody (satellite: abandoned items
+        # are skipped at fan-out, counted by the waiter itself)
+        items = [it for it in items if not it.abandoned]
+        if not items:
+            return
+        n = len(items)
         # few fixed batch buckets per (spec, shape): every new bucket is a
         # fresh XLA compile at serving time (measured as multi-second p95
         # spikes in the A/B bench). Buckets grow 4x so padding waste stays
@@ -442,16 +565,74 @@ class CrossModelBatcher:
             [it.X_pad for it in items]
             + [items[0].X_pad] * (b_pad - n)
         )
-        out = _stacked_apply(spec, items[0].n_pad, b_pad, bank.capacity)(
-            bank.stacked, idx, X
-        )
-        out = np.asarray(out)
+        # the busy window feeds the device watchdog: a wedged call here is
+        # what flips /healthcheck to 503 (resilience.stuck_device_call_s)
+        self._busy_since = time.monotonic()
+        try:
+            faults.fault_point(
+                "serve_device_call", machines=[it.tag for it in items]
+            )
+            out = np.asarray(
+                _stacked_apply(spec, items[0].n_pad, b_pad, bank.capacity)(
+                    bank.stacked, idx, X
+                )
+            )
+        finally:
+            self._busy_since = None
         self.stats["items"] += n
         self.stats["device_calls"] += 1
         self.stats["largest_batch"] = max(self.stats["largest_batch"], n)
+        validate = resilience.validate_output_enabled()
         for i, item in enumerate(items):
-            item.result = out[i, : item.n_keep]
+            result = out[i, : item.n_keep]
+            if validate and not np.all(np.isfinite(result)):
+                # per-lane guard: vmap lanes are independent, so a
+                # poisoned submission fails alone while its cohort's
+                # results fan out untouched
+                item.error = faults.NonFiniteDataError(
+                    f"non-finite fused-predict output for model "
+                    f"{item.tag or '?'!r}"
+                )
+            else:
+                item.result = result
             item.done.set()
+
+    def _serial_rescue(self, spec, item: _Item, group_exc: BaseException):
+        """Last ladder rung: retry one predict through the un-fused
+        program. Its failure (or a matching injected fault) lands on this
+        item alone."""
+        from gordo_tpu.server import resilience
+        from gordo_tpu.util import faults
+
+        if item.abandoned:
+            return
+        metric_catalog.GROUP_SERIAL_RESCUES.inc()
+        try:
+            self._busy_since = time.monotonic()
+            try:
+                faults.fault_point("serve_device_call", machines=[item.tag])
+                out = np.asarray(
+                    _single_apply(spec, item.n_pad)(item.params, item.X_pad)
+                )
+            finally:
+                self._busy_since = None
+            result = out[: item.n_keep]
+            if resilience.validate_output_enabled() and not np.all(
+                np.isfinite(result)
+            ):
+                raise faults.NonFiniteDataError(
+                    f"non-finite predict output for model "
+                    f"{item.tag or '?'!r}"
+                )
+            item.result = result
+        except BaseException as rescue_exc:  # noqa: BLE001 — this item only
+            logger.warning(
+                "serial rescue failed for model %r (group error %s: %s): %s",
+                item.tag or "?", type(group_exc).__name__, group_exc,
+                rescue_exc,
+            )
+            item.error = rescue_exc
+        item.done.set()
 
 
 # ------------------------------------------------------------ global switch

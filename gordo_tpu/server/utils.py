@@ -13,10 +13,13 @@ import io
 import logging
 import os
 import pickle
+import threading
+import time
 import zlib
+from collections import OrderedDict
 from datetime import datetime
 from functools import lru_cache
-from typing import List
+from typing import Dict, List, Tuple
 
 import dateutil.parser
 import pandas as pd
@@ -137,10 +140,107 @@ def verify_dataframe(df: pd.DataFrame, expected_columns: List[str]) -> pd.DataFr
 
 
 # ------------------------------------------------------------------- caches
-@lru_cache(maxsize=int(os.getenv("N_CACHED_MODELS", 2)))
+# load_model used to be a plain lru_cache. Two serving failure modes forced
+# the explicit version (PR 3 resilience):
+# - a corrupt artifact re-deserialized and re-raised on EVERY request
+#   forever (lru_cache only caches successes) — failures are now cached
+#   too, with a TTL so a repaired artifact heals without a restart;
+# - N concurrent first requests for one model deserialized it N times in
+#   parallel (dogpile) — a per-key lock now admits one loader; the rest
+#   wait for its outcome instead of repeating its work.
+_model_cache: "OrderedDict[Tuple[str, str], object]" = OrderedDict()
+_failed_loads: Dict[Tuple[str, str], Tuple[float, BaseException]] = {}
+_load_locks: Dict[Tuple[str, str], threading.Lock] = {}
+_cache_lock = threading.Lock()
+
+
+def _load_failure_ttl_s() -> float:
+    """TTL for negative (failed-load) cache entries; <=0 disables."""
+    try:
+        return float(os.environ.get("GORDO_TPU_LOAD_FAILURE_TTL_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def _cached_model_or_failure(key: Tuple[str, str]):
+    """(model, cached_exc): at most one is non-None; both None = miss.
+    Caller holds _cache_lock."""
+    if key in _model_cache:
+        _model_cache.move_to_end(key)
+        return _model_cache[key], None
+    entry = _failed_loads.get(key)
+    if entry is not None:
+        expires_at, exc = entry
+        if time.monotonic() < expires_at:
+            return None, exc
+        del _failed_loads[key]
+    return None, None
+
+
 def load_model(directory: str, name: str):
-    """Load (and cache) a model; params stay device-resident across requests."""
-    return serializer.load(os.path.join(directory, name))
+    """Load (and cache) a model; params stay device-resident across requests.
+
+    Keeps the most recent ``N_CACHED_MODELS`` models resident. Load
+    *failures* are negative-cached for ``GORDO_TPU_LOAD_FAILURE_TTL_S``
+    (except ``FileNotFoundError`` — a model appearing mid-rollover must
+    become servable immediately), and a per-key dogpile lock ensures one
+    deserialize per model no matter how many threads ask at once."""
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.util import faults
+
+    key = (directory, name)
+    with _cache_lock:
+        model, cached_exc = _cached_model_or_failure(key)
+        if model is not None:
+            return model
+        if cached_exc is not None:
+            metric_catalog.MODEL_LOAD_FAILURES.labels(kind="cached").inc()
+            raise cached_exc
+        lock = _load_locks.setdefault(key, threading.Lock())
+    with lock:
+        # dogpile gate: the winner loads; followers re-check its outcome
+        with _cache_lock:
+            model, cached_exc = _cached_model_or_failure(key)
+            if model is not None:
+                return model
+            if cached_exc is not None:
+                metric_catalog.MODEL_LOAD_FAILURES.labels(kind="cached").inc()
+                raise cached_exc
+        try:
+            faults.fault_point("serve_model_load", machine=name)
+            model = serializer.load(os.path.join(directory, name))
+        except FileNotFoundError:
+            raise
+        except Exception as exc:
+            ttl = _load_failure_ttl_s()
+            metric_catalog.MODEL_LOAD_FAILURES.labels(kind="fresh").inc()
+            if ttl > 0:
+                logger.warning(
+                    "model load failed for %r (%s: %s); caching the "
+                    "failure for %.0fs", name, type(exc).__name__, exc, ttl,
+                )
+                with _cache_lock:
+                    _failed_loads[key] = (time.monotonic() + ttl, exc)
+            raise
+        with _cache_lock:
+            _model_cache[key] = model
+            _model_cache.move_to_end(key)
+            max_models = max(1, int(os.getenv("N_CACHED_MODELS", 2)))
+            while len(_model_cache) > max_models:
+                _model_cache.popitem(last=False)
+        return model
+
+
+def _clear_model_cache():
+    with _cache_lock:
+        _model_cache.clear()
+        _failed_loads.clear()
+        _load_locks.clear()
+
+
+# API parity with the lru_cache it replaced (tests and
+# clear_model_caches() call load_model.cache_clear())
+load_model.cache_clear = _clear_model_cache
 
 
 @lru_cache(maxsize=25000)

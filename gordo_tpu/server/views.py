@@ -21,7 +21,9 @@ from gordo_tpu import __version__, serializer
 from gordo_tpu.dataset.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
 from gordo_tpu.server import model_io
+from gordo_tpu.server import resilience
 from gordo_tpu.server import utils as server_utils
+from gordo_tpu.util import faults
 
 logger = logging.getLogger(__name__)
 
@@ -128,9 +130,48 @@ def extract_X_y(request, mc: ModelContext):
 
 
 # ------------------------------------------------------------------- routes
-def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+def _breaker_response(ctx, info: dict) -> Response:
+    """Fast 503 from an open circuit breaker: JSON body naming the model
+    and the retry horizon, plus the Retry-After header."""
+    response = json_response(ctx, info, 503)
+    response.headers["Retry-After"] = resilience.breaker_retry_after_header(
+        info
+    )
+    return response
+
+
+def _load_model_guarded(ctx, breaker, gordo_name: str):
+    """Resolve the model, mapping a missing artifact to 404 (not a model
+    fault) and any other load failure to a breaker-recorded 500 response.
+    Returns ``(model_context, error_response)`` — exactly one is None."""
     mc = ModelContext(ctx, gordo_name)
-    mc.model  # force 404 before payload parsing
+    try:
+        mc.model
+    except NotFound:
+        raise
+    except Exception as exc:  # noqa: BLE001 — any load failure is a fault
+        resilience.record_breaker_failure(breaker, exc)
+        logger.error(
+            "Failed to load model %r:\n%s", gordo_name, traceback.format_exc()
+        )
+        return None, json_response(
+            ctx,
+            {"error": f"Model '{gordo_name}' failed to load"},
+            500,
+        )
+    return mc, None
+
+
+def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    breaker = resilience.breaker_for(gordo_name)
+    if breaker is not None:
+        open_info = breaker.allow()
+        if open_info is not None:
+            return _breaker_response(ctx, open_info)
+    # force 404 (and breaker-recorded load failures) before payload parsing
+    mc, load_error = _load_model_guarded(ctx, breaker, gordo_name)
+    if load_error is not None:
+        return load_error
     try:
         with ctx.phase("decode"):
             X, y = extract_X_y(request, mc)
@@ -141,15 +182,31 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
     start = timeit.default_timer()
     try:
         with ctx.phase("predict"):
+            faults.fault_point("serve_predict", machine=gordo_name)
+            X = faults.maybe_poison(gordo_name, X, site="serve_poison_nan")
+            # decode may have eaten the whole budget; fail before compute
+            resilience.check_deadline("preflight")
             output = model_io.get_model_output(model=mc.model, X=X)
+            resilience.check_output_finite(output, gordo_name)
+    except resilience.DeadlineExceeded as err:
+        logger.warning("Deadline exceeded predicting %r: %s", gordo_name, err)
+        return json_response(ctx, {"error": str(err)}, 504)
+    except faults.NonFiniteDataError as err:
+        # a server-side model fault (poisoned/diverged artifact), not a
+        # client data problem: 500, and the breaker counts it
+        resilience.record_breaker_failure(breaker, err)
+        logger.error("Non-finite output predicting %r: %s", gordo_name, err)
+        return json_response(ctx, {"error": str(err)}, 500)
     except ValueError as err:
         logger.error("Failed to predict: %s\n%s", err, traceback.format_exc())
         context["error"] = f"ValueError: {str(err)}"
         return json_response(ctx, context, 400)
-    except Exception:
+    except Exception as err:
+        resilience.record_breaker_failure(breaker, err)
         logger.error("Failed to predict:\n%s", traceback.format_exc())
         context["error"] = "Something unexpected happened; check your input data"
         return json_response(ctx, context, 400)
+    resilience.record_breaker_success(breaker)
 
     with ctx.phase("encode"):
         data = model_utils.make_base_dataframe(
@@ -174,7 +231,14 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
 
 def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
     start_time = timeit.default_timer()
-    mc = ModelContext(ctx, gordo_name)
+    breaker = resilience.breaker_for(gordo_name)
+    if breaker is not None:
+        open_info = breaker.allow()
+        if open_info is not None:
+            return _breaker_response(ctx, open_info)
+    mc, load_error = _load_model_guarded(ctx, breaker, gordo_name)
+    if load_error is not None:
+        return load_error
 
     if not hasattr(mc.model, "anomaly"):
         return json_response(
@@ -198,7 +262,12 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
 
     try:
         with ctx.phase("predict"):
+            faults.fault_point("serve_predict", machine=gordo_name)
+            resilience.check_deadline("preflight")
             anomaly_df = mc.model.anomaly(X, y, frequency=mc.frequency)
+    except resilience.DeadlineExceeded as exc:
+        logger.warning("Deadline exceeded predicting %r: %s", gordo_name, exc)
+        return json_response(ctx, {"error": str(exc)}, 504)
     except AttributeError as exc:
         return json_response(
             ctx,
@@ -207,6 +276,19 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
             },
             422,
         )
+    except faults.NonFiniteDataError as exc:
+        # raised by the batcher's per-lane output guard through the
+        # model's inner predict; the whole-frame anomaly output is NOT
+        # finiteness-checked (rolling smoothing legitimately yields NaN)
+        resilience.record_breaker_failure(breaker, exc)
+        logger.error("Non-finite output predicting %r: %s", gordo_name, exc)
+        return json_response(ctx, {"error": str(exc)}, 500)
+    except Exception as exc:
+        # unhandled anomaly failures keep propagating to the generic 500,
+        # but the breaker must still see them
+        resilience.record_breaker_failure(breaker, exc)
+        raise
+    resilience.record_breaker_success(breaker)
 
     with ctx.phase("encode"):
         if request.args.get("all_columns") is None:

@@ -44,10 +44,25 @@ the plan from this file path")::
 
 ``times``: how many matching invocations fire the rule (-1 = every
 invocation; ``poison_nan`` defaults to -1, fault sites to 1).
-``error``: ``transient`` | ``permanent`` | ``resource_exhausted``.
+``after``: how many matching invocations to let pass before the rule
+starts firing (0 = fire from the first match) — "wedge the *Nth* device
+call" is ``{"after": N-1, "times": 1}``.
+``error``: ``transient`` | ``permanent`` | ``resource_exhausted`` |
+``wedge`` (sleep ``seconds`` at the fault point instead of raising — a
+stuck device call / hung dependency stand-in).
 A ``bucket_compile`` rule matches any bucket whose member list contains
 ``machine``. Rules are matched in order and count their own firings, so a
 plan is a deterministic script, not a probability.
+
+Serve-side sites (PR 3, server/resilience.py): ``serve_model_load`` fires
+in the server's model-load path (machine = model name),
+``serve_predict`` in the request handler before the model's predict
+(supports ``wedge``), ``serve_device_call`` at the top of every fused
+device call in the cross-model batcher (machine matched against the fused
+group's members; supports ``wedge``), and ``serve_poison_nan`` NaN-poisons
+the request's feature matrix before predict (pair with
+``GORDO_TPU_VALIDATE_OUTPUT=1`` to turn the poisoned lane into a typed
+failure).
 """
 
 import json
@@ -279,7 +294,12 @@ class _FaultRule:
     machine: Optional[str] = None
     times: int = 1
     error: str = "transient"
+    # skip the first `after` matching invocations ("fail the Nth call")
+    after: int = 0
+    # wedge duration for error == "wedge" (a stuck-call stand-in)
+    seconds: float = 0.0
     fired: int = field(default=0, compare=False)
+    seen: int = field(default=0, compare=False)
 
     def matches(self, site: str, machine: Optional[str], machines: Sequence[str]):
         if site != self.site:
@@ -289,6 +309,17 @@ class _FaultRule:
         if machine is not None and machine == self.machine:
             return True
         return self.machine in machines
+
+    def armed(self) -> bool:
+        """Count one matching invocation; True when the rule fires on it
+        (past its ``after`` skip window, firing budget not exhausted)."""
+        self.seen += 1
+        if self.seen <= self.after:
+            return False
+        if self.times >= 0 and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
 
     def make_error(self, site: str, machine: Optional[str]) -> Exception:
         target = machine or self.machine or "*"
@@ -320,7 +351,10 @@ class FaultPlan:
             # data-altering sites apply on every matching call by default;
             # raising sites fire once
             times = entry.pop(
-                "times", -1 if site in ("poison_nan", "diverge") else 1
+                "times",
+                -1
+                if site in ("poison_nan", "serve_poison_nan", "diverge")
+                else 1,
             )
             rules.append(
                 _FaultRule(
@@ -328,6 +362,8 @@ class FaultPlan:
                     machine=entry.pop("machine", None),
                     times=int(times),
                     error=entry.pop("error", "transient"),
+                    after=int(entry.pop("after", 0)),
+                    seconds=float(entry.pop("seconds", 0.0)),
                 )
             )
             if entry:
@@ -340,13 +376,20 @@ class FaultPlan:
         machine: Optional[str] = None,
         machines: Sequence[str] = (),
     ) -> None:
-        """Raise the first matching, non-exhausted rule's error."""
+        """Raise the first matching, armed rule's error — or, for a
+        ``wedge`` rule, sleep its ``seconds`` in place (one action per
+        fault point either way)."""
         for rule in self.rules:
             if not rule.matches(site, machine, machines):
                 continue
-            if rule.times >= 0 and rule.fired >= rule.times:
+            if not rule.armed():
                 continue
-            rule.fired += 1
+            if rule.error == "wedge":
+                logger.warning(
+                    "fault plan: wedging %s for %.1fs", site, rule.seconds
+                )
+                time.sleep(rule.seconds)
+                return
             raise rule.make_error(site, machine)
 
     def should_fire(self, site: str, machine: str) -> bool:
@@ -354,10 +397,7 @@ class FaultPlan:
         of raising (``poison_nan``, ``diverge``); consumes the rule's
         firing budget the same way."""
         for rule in self.rules:
-            if rule.matches(site, machine, ()):
-                if rule.times >= 0 and rule.fired >= rule.times:
-                    continue
-                rule.fired += 1
+            if rule.matches(site, machine, ()) and rule.armed():
                 return True
         return False
 
@@ -405,12 +445,13 @@ def should_fire(site: str, machine: str) -> bool:
     return plan is not None and plan.should_fire(site, machine)
 
 
-def maybe_poison(machine: str, X):
+def maybe_poison(machine: str, X, site: str = "poison_nan"):
     """Injection hook: NaN-poison a machine's feature matrix (ndarray or
     DataFrame) per plan. Returns ``X`` unchanged when no rule matches (the
-    common case)."""
+    common case). ``site`` distinguishes the build-side hook (default)
+    from the serving twin (``serve_poison_nan``)."""
     plan = get_plan()
-    if plan is None or not plan.should_fire("poison_nan", machine):
+    if plan is None or not plan.should_fire(site, machine):
         return X
     import numpy as np
 

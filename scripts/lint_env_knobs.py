@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""
+Lint: every ``GORDO_TPU_*`` environment variable read anywhere under
+``gordo_tpu/`` must be documented somewhere under ``docs/`` (or README.md).
+
+The knob count has outgrown anyone's memory: build fault policy, fault
+plan, serving batcher, warmup, resilience (deadlines, shedding, breakers,
+drain, watchdog), parallelism, profiling... An env var that exists only in
+source is a knob operators cannot discover at exactly the moment they need
+it (a wedged pod, a shed storm). Same enforcement pattern as the PR 1
+bare-except lint and the PR 2 metric-name lint.
+
+Mechanics: source knobs are collected by regex over ``gordo_tpu/**/*.py``
+(string-literal mentions — the way env vars actually appear). Tokens
+ending in ``_`` are constructed prefixes (``f"GORDO_TPU_FAULT_{name}"``)
+and are skipped; their expansions must each be documented under their full
+names. Docs text is every ``*.md`` under the docs roots.
+
+Usage: ``python scripts/lint_env_knobs.py [src_root [docs_root ...]]``
+(default: ``gordo_tpu`` against ``docs`` + ``README.md``). Exit 0 = every
+knob documented, 1 = violations (one per line). Wired into tier-1 via
+tests/gordo_tpu/test_lint.py.
+"""
+
+import pathlib
+import re
+import sys
+from typing import Dict, List, Set
+
+_KNOB_RE = re.compile(r"GORDO_TPU_[A-Z0-9_]+")
+
+
+def source_knobs(src_root: str) -> Dict[str, str]:
+    """{knob: "file:line" of first mention} for every completed knob name
+    mentioned in the source tree."""
+    knobs: Dict[str, str] = {}
+    for path in sorted(pathlib.Path(src_root).rglob("*.py")):
+        for lineno, line in enumerate(
+            path.read_text(errors="replace").splitlines(), 1
+        ):
+            for token in _KNOB_RE.findall(line):
+                # trailing underscore = a constructed prefix, not a knob
+                if token.endswith("_"):
+                    continue
+                knobs.setdefault(token, f"{path}:{lineno}")
+    return knobs
+
+
+def documented_knobs(docs_roots: List[str]) -> Set[str]:
+    documented: Set[str] = set()
+    for root in docs_roots:
+        root_path = pathlib.Path(root)
+        if root_path.is_file():
+            documented.update(_KNOB_RE.findall(root_path.read_text(errors="replace")))
+            continue
+        for path in root_path.rglob("*.md"):
+            documented.update(_KNOB_RE.findall(path.read_text(errors="replace")))
+    return documented
+
+
+def find_undocumented(src_root: str, docs_roots: List[str]) -> List[str]:
+    documented = documented_knobs(docs_roots)
+    return [
+        f"{where}: {knob} is read in source but documented nowhere under "
+        f"{', '.join(docs_roots)}"
+        for knob, where in sorted(source_knobs(src_root).items())
+        if knob not in documented
+    ]
+
+
+def main(argv: List[str]) -> int:
+    src_root = argv[0] if argv else "gordo_tpu"
+    docs_roots = argv[1:] if len(argv) > 1 else ["docs", "README.md"]
+    violations = find_undocumented(src_root, docs_roots)
+    for line in violations:
+        print(line)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

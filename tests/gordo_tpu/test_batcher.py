@@ -5,6 +5,7 @@ and end-to-end through the WSGI app under concurrent load.
 
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -284,3 +285,190 @@ def test_calibration_interrupt_does_not_leak(models, monkeypatch):
     assert m.spec_ not in b._calibrating
     # no decision recorded: the next submit re-attempts calibration
     assert m.spec_ not in b._spec_on
+
+
+# --------------------------------------------- resilience (PR 3): timeouts,
+# abandoned items, fused-group fault isolation
+def _set_plan(monkeypatch, rules):
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv(faults.PLAN_ENV, json.dumps({"rules": rules}))
+    faults.reset_plan()
+
+
+@pytest.fixture()
+def _fresh_plan(monkeypatch):
+    from gordo_tpu.util import faults
+
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    faults.reset_plan()
+    yield
+    faults.reset_plan()
+
+
+def test_timeout_abandons_item_and_skips_it_at_fanout(
+    models, monkeypatch, _fresh_plan, caplog
+):
+    """A wedged device call: the waiter times out (counted, logged once),
+    and an item still queued behind the wedge is SKIPPED at fan-out rather
+    than computed for nobody."""
+    import logging
+
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_device_call", "times": 1, "error": "wedge",
+          "seconds": 1.0}],
+    )
+    b = CrossModelBatcher(window_ms=0, max_batch=8, timeout_s=0.2)
+    X = np.random.RandomState(7).rand(12, 4).astype(np.float32)
+    abandoned_before = metric_catalog.BATCHER_ABANDONED.value()
+
+    errors = {}
+
+    def submit(key, i):
+        try:
+            b.submit(models[i].spec_, models[i].params_, X)
+        except BaseException as exc:  # noqa: BLE001
+            errors[key] = exc
+
+    with caplog.at_level(logging.WARNING, logger="gordo_tpu.server.batcher"):
+        t1 = threading.Thread(target=submit, args=("wedged", 0))
+        t1.start()
+        time.sleep(0.4)  # the dispatcher is now inside the wedged call
+        # the watchdog sees the dispatcher stuck in ONE device call
+        assert b.device_call_stuck_s() > 0.2
+        t2 = threading.Thread(target=submit, args=("queued", 1))
+        t2.start()
+        t1.join()
+        t2.join()
+    assert isinstance(errors["wedged"], TimeoutError)
+    assert isinstance(errors["queued"], TimeoutError)
+    assert metric_catalog.BATCHER_ABANDONED.value() == abandoned_before + 2
+    # the wedged item was already inside its device call (computed anyway);
+    # the queued one was dequeued AFTER its waiter left and skipped
+    deadline = time.monotonic() + 5
+    while b.stats["device_calls"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert b.stats["items"] == 1
+    # the spec/shape is logged once, not once per abandon
+    abandon_logs = [
+        r for r in caplog.records if "abandoned by its waiter" in r.message
+    ]
+    assert len(abandon_logs) == 1
+    # the batcher recovers: a fresh submit (no rule left) serves normally
+    out = b.submit(models[2].spec_, models[2].params_, X)
+    np.testing.assert_allclose(
+        out, models[2].predict(X), rtol=1e-6, atol=1e-7
+    )
+    assert b.device_call_stuck_s() == 0.0
+
+
+def test_deadline_in_scope_bounds_queue_wait(models, monkeypatch, _fresh_plan):
+    """A request deadline (resilience scope) beats the batcher's own
+    timeout and surfaces as DeadlineExceeded."""
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.server import resilience
+
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_device_call", "times": 1, "error": "wedge",
+          "seconds": 0.8}],
+    )
+    b = CrossModelBatcher(window_ms=0, max_batch=8, timeout_s=300)
+    X = np.random.RandomState(8).rand(12, 4).astype(np.float32)
+    before = metric_catalog.SERVER_DEADLINE_EXCEEDED.value(where="queue_wait")
+    with resilience.request_scope(model="m-deadline", deadline_ms=150):
+        with pytest.raises(resilience.DeadlineExceeded):
+            b.submit(models[0].spec_, models[0].params_, X)
+    assert (
+        metric_catalog.SERVER_DEADLINE_EXCEEDED.value(where="queue_wait")
+        == before + 1
+    )
+
+
+def test_fused_group_failure_isolates_poisoned_member(
+    models, monkeypatch, _fresh_plan
+):
+    """The ladder, driven deterministically through _run_group: a group
+    device-call failure bisects down to the poisoned member; the cohort's
+    results are correct, only the poisoned item errors."""
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.ops.train import pad_for_predict
+    from gordo_tpu.server.batcher import _Item
+    from gordo_tpu.util import faults
+
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_device_call", "machine": "m-poisoned",
+          "times": -1, "error": "permanent"}],
+    )
+    b = CrossModelBatcher(window_ms=0, max_batch=8)
+    X = np.random.RandomState(9).rand(30, 4).astype(np.float32)
+    spec = models[0].spec_
+    tags = ["m-ok-0", "m-poisoned", "m-ok-2"]
+    items = []
+    for model, tag in zip(models, tags):
+        X_pad, n_pad, n_keep = pad_for_predict(spec, X)
+        item = _Item(spec, model.params_, X_pad, n_pad, n_keep)
+        item.t_submit = time.monotonic()
+        item.tag = tag
+        items.append(item)
+    bisect_before = metric_catalog.GROUP_BISECTIONS.value()
+    rescue_before = metric_catalog.GROUP_SERIAL_RESCUES.value()
+
+    b._run_group(spec, items)
+
+    assert all(item.done.is_set() for item in items)
+    assert isinstance(items[1].error, faults.PermanentFault)
+    for i in (0, 2):
+        assert items[i].error is None
+        np.testing.assert_allclose(
+            items[i].result, models[i].predict(X), rtol=1e-6, atol=1e-7
+        )
+    # [ok, P, ok] -> bisect into [ok] and [P, ok] -> bisect into [P], [ok]
+    # -> P's singleton serial rescue also faults; exactly 2 bisections
+    assert metric_catalog.GROUP_BISECTIONS.value() == bisect_before + 2
+    assert metric_catalog.GROUP_SERIAL_RESCUES.value() == rescue_before + 1
+
+
+def test_nan_poisoned_lane_fails_alone_under_output_guard(
+    models, monkeypatch, _fresh_plan
+):
+    """With the output guard on, a NaN input poisons only its own vmap
+    lane: concurrent cohort submits through the REAL queue still succeed
+    with correct results."""
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv("GORDO_TPU_VALIDATE_OUTPUT", "1")
+    b = CrossModelBatcher(window_ms=60, max_batch=8)
+    rng = np.random.RandomState(10)
+    X_ok = rng.rand(24, 4).astype(np.float32)
+    X_bad = X_ok.copy()
+    X_bad[0, 0] = np.nan
+
+    results, errors = {}, {}
+    barrier = threading.Barrier(3)
+
+    def run(i, X):
+        barrier.wait()
+        try:
+            results[i] = b.submit(models[i].spec_, models[i].params_, X)
+        except BaseException as exc:  # noqa: BLE001
+            errors[i] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(0, X_ok)),
+        threading.Thread(target=run, args=(1, X_bad)),
+        threading.Thread(target=run, args=(2, X_ok)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert isinstance(errors[1], faults.NonFiniteDataError)
+    for i in (0, 2):
+        np.testing.assert_allclose(
+            results[i], models[i].predict(X_ok), rtol=1e-6, atol=1e-7
+        )

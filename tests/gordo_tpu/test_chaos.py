@@ -363,3 +363,202 @@ def test_serial_builder_rejects_poisoned_data(monkeypatch):
     _set_plan(monkeypatch, [{"site": "poison_nan", "machine": "sp-0"}])
     with pytest.raises(faults.NonFiniteDataError):
         ModelBuilder(machine).build()
+
+
+# ----------------------------------------------------- serving resilience
+def _assert_payload_close(got, want, path=""):
+    """Structural equality with approximate float leaves — fused widths
+    vary run to run and XLA float32 is not bitwise-stable across vmap
+    widths (same tolerance rationale as test_batcher.py)."""
+    import numpy as np
+
+    assert type(got) is type(want), f"{path}: {type(got)} != {type(want)}"
+    if isinstance(got, dict):
+        assert got.keys() == want.keys(), f"{path}: keys differ"
+        for k in got:
+            _assert_payload_close(got[k], want[k], f"{path}.{k}")
+    elif isinstance(got, list):
+        assert len(got) == len(want), f"{path}: lengths differ"
+        for i, (g, w) in enumerate(zip(got, want)):
+            _assert_payload_close(g, w, f"{path}[{i}]")
+    elif isinstance(got, float):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=path)
+    else:
+        assert got == want, f"{path}: {got!r} != {want!r}"
+
+
+def test_chaos_serving_wedge_and_poison_degrade_only_themselves(
+    monkeypatch, model_collection_directory, trained_model_directories,
+    gordo_project, gordo_name, second_gordo_name, X_payload,
+):
+    """Serving headline scenario: 12 concurrent clients against one
+    in-process server with the cross-model batcher on, while the fault
+    plan (a) wedges one fused device call for 2.5s and (b) NaN-poisons
+    every predict of one model. Blast radius must be exactly the faults'
+    own: every healthy-model request eventually succeeds with correct
+    values (shed 503s and deadline 504s are retried), the circuit breaker
+    opens for the poisoned model only, /healthcheck flips to 503 exactly
+    while the dispatcher is wedged, and the shed/deadline/breaker/abandon
+    counters land in /metrics."""
+    import threading
+    import time
+
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.server import batcher as batcher_mod
+    from gordo_tpu.server import resilience
+    from gordo_tpu.server import utils as server_utils
+    from gordo_tpu.server.server import build_app
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    poisoned, healthy = gordo_name, second_gordo_name
+
+    resilience.reset_for_tests()
+    server_utils.clear_model_caches()
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setenv("GORDO_TPU_MAX_INFLIGHT", "4")
+    monkeypatch.setenv("GORDO_TPU_RETRY_AFTER_S", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "60")
+    monkeypatch.setenv("GORDO_TPU_WATCHDOG_S", "0.2")
+    monkeypatch.setenv("GORDO_TPU_VALIDATE_OUTPUT", "1")
+
+    app = build_app({
+        "MODEL_COLLECTION_DIR": model_collection_directory,
+        "ENABLE_PROMETHEUS": True,
+        "PROJECT": "gordo-test",
+    })
+    body = json.dumps({"X": dataframe_to_dict(X_payload)}).encode()
+
+    def post(client, name, headers=None):
+        return client.post(
+            f"/gordo/v0/{gordo_project}/{name}/prediction",
+            data=body, content_type="application/json",
+            headers=headers or {},
+        )
+
+    # fault-free warm pass (loads models, compiles the width-1 fused
+    # program, records the correct healthy payload) BEFORE arming faults
+    # or deadlines
+    warm = post(app.test_client(), healthy)
+    assert warm.status_code == 200, warm.data
+    baseline_data = json.loads(warm.data)["data"]
+    assert post(app.test_client(), poisoned).status_code == 200
+
+    # deadline armed only for the faulted phase: queued requests must
+    # abandon behind the wedge instead of waiting it out
+    monkeypatch.setenv("GORDO_TPU_DEADLINE_MS", "2000")
+    _set_plan(monkeypatch, [
+        {"site": "serve_device_call", "times": 1, "error": "wedge",
+         "seconds": 2.5},
+        {"site": "serve_poison_nan", "machine": poisoned},
+    ])
+
+    shed_before = metric_catalog.SERVER_SHED.value(reason="max_inflight")
+    abandoned_before = metric_catalog.BATCHER_ABANDONED.value()
+
+    outcomes = {}
+    saw_shed = []
+    saw_deadline = []
+
+    def client_thread(idx, name):
+        client = app.test_client()
+        deadline = time.monotonic() + 60
+        got_500 = False
+        while time.monotonic() < deadline:
+            resp = post(client, name)
+            if resp.status_code == 200:
+                outcomes[idx] = ("ok", json.loads(resp.data)["data"])
+                return
+            payload = resp.get_json()
+            if resp.status_code == 503 and payload.get("model") == name:
+                # breaker fast-fail: terminal for a poisoned model
+                assert resp.headers.get("Retry-After") is not None
+                outcomes[idx] = ("breaker", got_500)
+                return
+            if resp.status_code == 503:
+                assert payload.get("reason") == "max_inflight"
+                assert resp.headers.get("Retry-After") is not None
+                saw_shed.append(idx)
+            elif resp.status_code == 504:
+                saw_deadline.append(idx)
+            elif resp.status_code == 500:
+                got_500 = True  # the poisoned lane's typed failure
+            else:
+                outcomes[idx] = ("unexpected", resp.status_code, payload)
+                return
+            time.sleep(0.05)
+        outcomes[idx] = ("timeout",)
+
+    threads = [
+        threading.Thread(target=client_thread, args=(i, healthy))
+        for i in range(8)
+    ] + [
+        threading.Thread(target=client_thread, args=(8 + i, poisoned))
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+
+    # while the fused call is wedged the device watchdog must flip
+    # /healthcheck to 503 (and back to 200 once the wedge clears)
+    health = app.test_client()
+    saw_watchdog_503 = False
+    probe_deadline = time.monotonic() + 30
+    while any(t.is_alive() for t in threads):
+        if health.get("/healthcheck").status_code == 503:
+            saw_watchdog_503 = True
+        if time.monotonic() > probe_deadline:
+            break
+        time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads)
+
+    # blast radius: every healthy client succeeded with correct values...
+    for i in range(8):
+        kind = outcomes[i][0]
+        assert kind == "ok", f"healthy client {i}: {outcomes[i]}"
+        _assert_payload_close(outcomes[i][1], baseline_data)
+    # ...every poisoned client ended on the open breaker
+    for i in range(8, 12):
+        assert outcomes[i][0] == "breaker", f"poisoned client {i}: {outcomes[i]}"
+    assert any(outcomes[i][1] for i in range(8, 12)), (
+        "no poisoned client ever observed the typed 500 that opened "
+        "the breaker"
+    )
+
+    # breaker open for the poisoned model ONLY
+    assert resilience.breaker_for(poisoned).state == resilience.OPEN
+    assert resilience.breaker_for(healthy).state == resilience.CLOSED
+    assert (
+        metric_catalog.BREAKER_STATE.value(model=poisoned)
+        == resilience.OPEN
+    )
+
+    # the wedge was observed end to end: healthcheck flipped while the
+    # dispatcher was stuck and recovered afterwards
+    assert saw_watchdog_503, "watchdog never flipped /healthcheck to 503"
+    assert health.get("/healthcheck").status_code == 200
+
+    # load was actually shed and deadlines actually expired (12 clients
+    # vs MAX_INFLIGHT=4 and a 2.5s wedge vs a 2s budget guarantee both)
+    assert metric_catalog.SERVER_SHED.value(reason="max_inflight") > shed_before
+    assert metric_catalog.BATCHER_ABANDONED.value() > abandoned_before
+    assert saw_shed and saw_deadline
+
+    # the counters are a /metrics contract, not just process state
+    metrics_text = app.test_client().get("/metrics").data.decode()
+    for series in (
+        "gordo_server_shed_total",
+        "gordo_server_deadline_exceeded_total",
+        "gordo_server_batcher_abandoned_total",
+        "gordo_server_breaker_state",
+        "gordo_server_breaker_opens_total",
+        "gordo_server_watchdog_trips_total",
+    ):
+        assert series in metrics_text, f"{series} missing from /metrics"
+
+    resilience.reset_for_tests()
+    server_utils.clear_model_caches()

@@ -8,6 +8,10 @@ Tier-1 lint gates.
 - Every registered metric carries a ``gordo_`` prefix and non-empty help
   text (scripts/lint_metric_names.py): metric names are a public API for
   dashboards and alerts; help strings are the operator docs at /metrics.
+- Every ``GORDO_TPU_*`` env var read in gordo_tpu/ is documented under
+  docs/ or README.md (scripts/lint_env_knobs.py): the knob count has
+  outgrown anyone's memory, and an undocumented knob is undiscoverable
+  at exactly the moment an operator needs it.
 """
 
 import pathlib
@@ -17,6 +21,7 @@ import sys
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 LINT = REPO_ROOT / "scripts" / "lint_bare_except.py"
 METRIC_LINT = REPO_ROOT / "scripts" / "lint_metric_names.py"
+KNOB_LINT = REPO_ROOT / "scripts" / "lint_env_knobs.py"
 
 
 def test_no_bare_except_in_gordo_tpu():
@@ -109,4 +114,55 @@ def test_metric_lint_accepts_prefixed_documented_metrics(tmp_path):
         "counts = collections.Counter([1, 2, 2])\n"
     )
     result = _run_metric_lint(tmp_path)
+    assert result.returncode == 0, result.stdout
+
+
+# ------------------------------------------------------- env-knob lint
+def _run_knob_lint(*args):
+    return subprocess.run(
+        [sys.executable, str(KNOB_LINT), *map(str, args)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_every_env_knob_in_gordo_tpu_is_documented():
+    result = _run_knob_lint()  # defaults: gordo_tpu vs docs/ + README.md
+    assert result.returncode == 0, (
+        f"undocumented GORDO_TPU_* knob introduced:\n"
+        f"{result.stdout}{result.stderr}"
+    )
+
+
+def test_knob_lint_flags_undocumented_knob(tmp_path):
+    src = tmp_path / "src"
+    docs = tmp_path / "docs"
+    src.mkdir(), docs.mkdir()
+    (src / "mod.py").write_text(
+        'import os\n'
+        'a = os.environ.get("GORDO_TPU_DOCUMENTED_KNOB")\n'
+        'b = os.environ.get("GORDO_TPU_SECRET_KNOB")\n'
+        '# constructed prefixes are skipped, expansions must be named:\n'
+        'c = os.environ.get(f"GORDO_TPU_DYNAMIC_{a}")\n'
+    )
+    (docs / "page.md").write_text(
+        "| `GORDO_TPU_DOCUMENTED_KNOB` | does things |\n"
+    )
+    result = _run_knob_lint(src, docs)
+    assert result.returncode == 1
+    assert "GORDO_TPU_SECRET_KNOB" in result.stdout
+    assert "GORDO_TPU_DOCUMENTED_KNOB" not in result.stdout
+    assert "GORDO_TPU_DYNAMIC_" not in result.stdout
+
+
+def test_knob_lint_accepts_fully_documented_tree(tmp_path):
+    src = tmp_path / "src"
+    docs = tmp_path / "docs"
+    src.mkdir(), docs.mkdir()
+    (src / "mod.py").write_text(
+        'import os\nx = os.environ.get("GORDO_TPU_FINE_KNOB")\n'
+    )
+    (docs / "page.md").write_text("`GORDO_TPU_FINE_KNOB` turns it on\n")
+    result = _run_knob_lint(src, docs)
     assert result.returncode == 0, result.stdout

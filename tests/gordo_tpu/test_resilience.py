@@ -1,0 +1,428 @@
+"""
+Serving resilience layer (server/resilience.py): admission control,
+deadlines, circuit breakers, the negative model-load cache, and the device
+watchdog — unit-level plus in-process WSGI drives.
+
+Every knob defaults off; each test arms exactly the knob under test via
+monkeypatch and resets the process-wide state afterwards.
+"""
+
+import json
+import pathlib
+import threading
+import time
+
+import pytest
+
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.server import resilience
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.util import faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state(monkeypatch):
+    """Gate counters, breakers, drain flag, fault plan: zeroed per test."""
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    faults.reset_plan()
+    resilience.reset_for_tests()
+    yield
+    faults.reset_plan()
+    resilience.reset_for_tests()
+
+
+def _set_plan(monkeypatch, rules):
+    monkeypatch.setenv(faults.PLAN_ENV, json.dumps({"rules": rules}))
+    faults.reset_plan()
+
+
+# ---------------------------------------------------------- admission gate
+def test_gate_disabled_by_default():
+    for _ in range(64):
+        assert resilience.try_admit() is None
+    assert resilience.gated_inflight() == 64
+
+
+def test_gate_sheds_past_limit_and_releases(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_MAX_INFLIGHT", "2")
+    monkeypatch.setenv("GORDO_TPU_RETRY_AFTER_S", "7")
+    before = metric_catalog.SERVER_SHED.value(reason="max_inflight")
+    assert resilience.try_admit() is None
+    assert resilience.try_admit() is None
+    shed = resilience.try_admit()
+    assert shed is not None
+    assert shed["reason"] == "max_inflight"
+    assert shed["retry-after-seconds"] == 7.0
+    assert metric_catalog.SERVER_SHED.value(reason="max_inflight") == before + 1
+    # a shed holds no slot; a release frees one
+    resilience.release()
+    assert resilience.try_admit() is None
+
+
+# --------------------------------------------------------------- deadlines
+def test_deadline_scope_and_check(monkeypatch):
+    assert resilience.remaining_s() is None  # no scope: no budget
+    with resilience.request_scope(model="m", deadline_ms=10_000):
+        assert resilience.current_model() == "m"
+        remaining = resilience.remaining_s()
+        assert remaining is not None and 9 < remaining <= 10
+        resilience.check_deadline("preflight")  # plenty left: no raise
+    with resilience.request_scope(model="m", deadline_ms=1):
+        time.sleep(0.01)
+        before = metric_catalog.SERVER_DEADLINE_EXCEEDED.value(
+            where="preflight"
+        )
+        with pytest.raises(resilience.DeadlineExceeded):
+            resilience.check_deadline("preflight")
+        assert (
+            metric_catalog.SERVER_DEADLINE_EXCEEDED.value(where="preflight")
+            == before + 1
+        )
+    assert resilience.current_model() is None  # scope restored
+
+
+def test_deadline_header_beats_env_default(monkeypatch):
+    assert resilience.deadline_ms_from({}) is None
+    monkeypatch.setenv("GORDO_TPU_DEADLINE_MS", "500")
+    assert resilience.deadline_ms_from({}) == 500.0
+    assert (
+        resilience.deadline_ms_from({"X-Gordo-Deadline-Ms": "125"}) == 125.0
+    )
+    # malformed values are ignored (not a 400): falls back to nothing
+    monkeypatch.delenv("GORDO_TPU_DEADLINE_MS")
+    assert resilience.deadline_ms_from({"X-Gordo-Deadline-Ms": "soon"}) is None
+    assert resilience.deadline_ms_from({"X-Gordo-Deadline-Ms": "-5"}) is None
+
+
+# ---------------------------------------------------------- circuit breaker
+def test_breaker_disabled_without_threshold():
+    assert resilience.breaker_for("any-model") is None
+
+
+def test_breaker_opens_after_consecutive_transient_failures(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "60")
+    breaker = resilience.breaker_for("m-a")
+    for _ in range(2):
+        breaker.record_failure(faults.TransientFault("hiccup"))
+        assert breaker.allow() is None  # still closed
+    breaker.record_failure(faults.TransientFault("hiccup"))
+    info = breaker.allow()
+    assert info is not None and info["model"] == "m-a"
+    assert 0 < info["retry-after-seconds"] <= 60
+    assert breaker.state == resilience.OPEN
+    assert metric_catalog.BREAKER_STATE.value(model="m-a") == resilience.OPEN
+    # a success between failures resets the consecutive count
+    breaker2 = resilience.breaker_for("m-b")
+    breaker2.record_failure(faults.TransientFault("x"))
+    breaker2.record_failure(faults.TransientFault("x"))
+    breaker2.record_success()
+    breaker2.record_failure(faults.TransientFault("x"))
+    assert breaker2.state == resilience.CLOSED
+
+
+def test_breaker_permanent_fault_opens_immediately(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "5")
+    breaker = resilience.breaker_for("m-c")
+    breaker.record_failure(faults.NonFiniteDataError("poisoned output"))
+    assert breaker.state == resilience.OPEN
+
+
+def test_breaker_half_open_probe_closes_or_reopens(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "0.05")
+    breaker = resilience.breaker_for("m-d")
+    breaker.record_failure(faults.PermanentFault("corrupt"))
+    assert breaker.allow() is not None  # open, cooling down
+    time.sleep(0.06)
+    assert breaker.allow() is None  # half-open: this caller is the probe
+    assert breaker.state == resilience.HALF_OPEN
+    # concurrent request during the probe still fast-fails
+    assert breaker.allow() is not None
+    breaker.record_failure(faults.PermanentFault("still corrupt"))
+    assert breaker.state == resilience.OPEN
+    time.sleep(0.06)
+    assert breaker.allow() is None
+    breaker.record_success()
+    assert breaker.state == resilience.CLOSED
+    assert breaker.allow() is None
+
+
+# ------------------------------------------------------------ output guard
+def test_output_guard_off_by_default():
+    import numpy as np
+
+    resilience.check_output_finite(np.array([1.0, float("nan")]), "m")
+
+
+def test_output_guard_raises_when_enabled(monkeypatch):
+    import numpy as np
+
+    monkeypatch.setenv("GORDO_TPU_VALIDATE_OUTPUT", "1")
+    resilience.check_output_finite(np.ones(4), "m")
+    with pytest.raises(faults.NonFiniteDataError, match="'m'"):
+        resilience.check_output_finite(np.array([1.0, float("inf")]), "m")
+
+
+# -------------------------------------------------------- device watchdog
+class _FakeBatcher:
+    def __init__(self, stuck):
+        self._stuck = stuck
+
+    def device_call_stuck_s(self):
+        return self._stuck
+
+
+def test_watchdog_flags_stuck_dispatcher(monkeypatch):
+    import gordo_tpu.server.batcher as batcher_mod
+
+    assert resilience.stuck_device_call_s() is None  # knob unset: off
+    monkeypatch.setenv("GORDO_TPU_WATCHDOG_S", "0.5")
+    monkeypatch.setattr(batcher_mod, "_batcher", _FakeBatcher(0.1))
+    assert resilience.stuck_device_call_s() is None  # busy but under limit
+    before = metric_catalog.WATCHDOG_TRIPS.value()
+    monkeypatch.setattr(batcher_mod, "_batcher", _FakeBatcher(1.2))
+    assert resilience.stuck_device_call_s() == pytest.approx(1.2)
+    assert metric_catalog.WATCHDOG_TRIPS.value() == before + 1
+
+
+# ------------------------------------------------------------------- drain
+def test_drain_waits_for_inflight():
+    assert resilience.begin_drain() is True
+    assert resilience.begin_drain() is False  # only the first caller wins
+    assert resilience.is_draining()
+    resilience.request_started()
+    done = []
+
+    def finish_later():
+        time.sleep(0.15)
+        resilience.request_finished()
+        done.append(True)
+
+    threading.Thread(target=finish_later).start()
+    assert resilience.wait_drained(budget_s=5.0) is True
+    assert done == [True]
+
+
+def test_drain_budget_bounds_the_wait():
+    resilience.request_started()  # never finished
+    t0 = time.monotonic()
+    assert resilience.wait_drained(budget_s=0.2) is False
+    assert time.monotonic() - t0 < 2.0
+
+
+# ------------------------------------- model load: negative cache + dogpile
+def _write_corrupt_model(tmp_path, name):
+    mdir = tmp_path / name
+    mdir.mkdir()
+    (mdir / "metadata.json").write_text(json.dumps({"dataset": {"tags": []}}))
+    (mdir / "model.pkl").write_bytes(b"\x80\x04 truncated garbage")
+    return str(tmp_path)
+
+
+def test_load_failure_is_negative_cached(tmp_path, monkeypatch):
+    directory = _write_corrupt_model(tmp_path, "m-corrupt")
+    server_utils.clear_model_caches()
+    calls = []
+    real_load = server_utils.serializer.load
+
+    def counting_load(path):
+        calls.append(path)
+        return real_load(path)
+
+    monkeypatch.setattr(server_utils.serializer, "load", counting_load)
+    fresh_before = metric_catalog.MODEL_LOAD_FAILURES.value(kind="fresh")
+    cached_before = metric_catalog.MODEL_LOAD_FAILURES.value(kind="cached")
+    with pytest.raises(Exception) as first:
+        server_utils.load_model(directory, "m-corrupt")
+    # within the TTL the cached failure answers without re-deserializing
+    with pytest.raises(Exception) as second:
+        server_utils.load_model(directory, "m-corrupt")
+    assert len(calls) == 1
+    assert second.value is first.value
+    assert (
+        metric_catalog.MODEL_LOAD_FAILURES.value(kind="fresh")
+        == fresh_before + 1
+    )
+    assert (
+        metric_catalog.MODEL_LOAD_FAILURES.value(kind="cached")
+        == cached_before + 1
+    )
+    server_utils.clear_model_caches()
+
+
+def test_load_failure_ttl_zero_disables_negative_cache(tmp_path, monkeypatch):
+    directory = _write_corrupt_model(tmp_path, "m-corrupt2")
+    monkeypatch.setenv("GORDO_TPU_LOAD_FAILURE_TTL_S", "0")
+    server_utils.clear_model_caches()
+    calls = []
+    real_load = server_utils.serializer.load
+
+    def counting_load(path):
+        calls.append(path)
+        return real_load(path)
+
+    monkeypatch.setattr(server_utils.serializer, "load", counting_load)
+    for _ in range(2):
+        with pytest.raises(Exception):
+            server_utils.load_model(directory, "m-corrupt2")
+    assert len(calls) == 2  # every request re-reads, the old behavior
+    server_utils.clear_model_caches()
+
+
+def test_missing_model_is_not_negative_cached(tmp_path):
+    server_utils.clear_model_caches()
+    with pytest.raises(FileNotFoundError):
+        server_utils.load_model(str(tmp_path), "not-there")
+    # the model appears (rollover in progress) and must serve immediately:
+    # the miss was NOT cached, so the next load re-checks the filesystem
+    with pytest.raises(FileNotFoundError):
+        server_utils.load_model(str(tmp_path), "not-there")
+    server_utils.clear_model_caches()
+
+
+def test_dogpile_lock_single_deserialize(tmp_path, monkeypatch):
+    """N threads asking for one uncached model trigger ONE deserialize."""
+    server_utils.clear_model_caches()
+    calls = []
+
+    def slow_load(path):
+        calls.append(path)
+        time.sleep(0.1)
+        return {"model": path}
+
+    monkeypatch.setattr(server_utils.serializer, "load", slow_load)
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(
+                server_utils.load_model(str(tmp_path), "m-big")
+            )
+        )
+        for _ in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1
+    assert len(results) == 6
+    assert all(r is results[0] for r in results)
+    server_utils.clear_model_caches()
+
+
+def test_injected_load_fault_counts_and_caches(tmp_path, monkeypatch):
+    """The serve_model_load fault site fails a load deterministically and
+    the failure is negative-cached like a real one."""
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_model_load", "machine": "m-x", "times": 1,
+          "error": "permanent"}],
+    )
+    server_utils.clear_model_caches()
+    with pytest.raises(faults.PermanentFault):
+        server_utils.load_model(str(tmp_path), "m-x")
+    # rule exhausted (times=1) — but the negative cache still answers
+    with pytest.raises(faults.PermanentFault):
+        server_utils.load_model(str(tmp_path), "m-x")
+    server_utils.clear_model_caches()
+
+
+# ----------------------------------------------- WSGI drives (no models)
+@pytest.fixture()
+def app(tmp_path):
+    from gordo_tpu.server.server import build_app
+
+    server_utils.clear_model_caches()
+    collection = tmp_path / "rev-1"
+    collection.mkdir()
+    return build_app({"MODEL_COLLECTION_DIR": str(collection)})
+
+
+def test_shed_e2e_503_with_retry_after(app, monkeypatch):
+    """One request wedged inside the gated section + MAX_INFLIGHT=1: the
+    concurrent request is shed with 503 + Retry-After, and the gate frees
+    once the wedged request finishes."""
+    monkeypatch.setenv("GORDO_TPU_MAX_INFLIGHT", "1")
+    monkeypatch.setenv("GORDO_TPU_RETRY_AFTER_S", "3")
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_model_load", "times": 1, "error": "wedge",
+          "seconds": 0.8}],
+    )
+    url = "/gordo/v0/p/some-model/prediction"
+    statuses = {}
+
+    def wedged():
+        # the wedge fires inside load_model; the request then 404s (no
+        # such model) — what matters is that it HOLDS its gate slot
+        statuses["wedged"] = app.test_client().post(url, json={}).status_code
+
+    t = threading.Thread(target=wedged)
+    t.start()
+    time.sleep(0.3)  # the wedged request is inside the gated section
+    resp = app.test_client().post(url, json={})
+    assert resp.status_code == 503
+    assert resp.headers["Retry-After"] == "3"
+    body = resp.get_json()
+    assert body["reason"] == "max_inflight"
+    t.join()
+    assert statuses["wedged"] == 404
+    # slot released: the next request is admitted (404, not 503)
+    assert app.test_client().post(url, json={}).status_code == 404
+
+
+def test_breaker_e2e_corrupt_artifact_fast_fails(app, tmp_path, monkeypatch):
+    """A corrupt artifact: first request pays the load failure (500),
+    the breaker opens (permanent-class), and subsequent requests fast-fail
+    503 naming the model — without re-reading the artifact every time."""
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "60")
+    collection = app.config["MODEL_COLLECTION_DIR"]
+    _write_corrupt_model(pathlib.Path(collection), "m-bad")
+    url = "/gordo/v0/p/m-bad/prediction"
+    client = app.test_client()
+    resp = client.post(url, json={})
+    assert resp.status_code == 500
+    assert "failed to load" in resp.get_json()["error"]
+    resp = client.post(url, json={})
+    assert resp.status_code == 503
+    body = resp.get_json()
+    assert body["model"] == "m-bad"
+    assert "retry-after-seconds" in body
+    assert int(resp.headers["Retry-After"]) >= 0
+    assert (
+        resilience.breaker_for("m-bad").state == resilience.OPEN
+    )
+
+
+def test_deadline_e2e_504(monkeypatch, model_collection_directory,
+                          trained_model_directories, gordo_project,
+                          gordo_name, X_payload):
+    """A wedged predict + a small deadline header: 504, not a hang."""
+    from gordo_tpu.server.server import build_app
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    server_utils.clear_model_caches()
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_predict", "times": 1, "error": "wedge",
+          "seconds": 0.4}],
+    )
+    url = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    before = metric_catalog.SERVER_DEADLINE_EXCEEDED.value(where="preflight")
+    resp = app.test_client().post(
+        url,
+        json={"X": dataframe_to_dict(X_payload)},
+        headers={"X-Gordo-Deadline-Ms": "100"},
+    )
+    assert resp.status_code == 504
+    assert "deadline" in resp.get_json()["error"].lower()
+    assert (
+        metric_catalog.SERVER_DEADLINE_EXCEEDED.value(where="preflight")
+        == before + 1
+    )
+    # without the header the same route still serves
+    resp = app.test_client().post(url, json={"X": dataframe_to_dict(X_payload)})
+    assert resp.status_code == 200

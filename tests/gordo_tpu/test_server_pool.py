@@ -33,7 +33,7 @@ sys.path.insert(0, {repo!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
 from gordo_tpu.server.server import run_server
-run_server(host="127.0.0.1", port={port}, workers={workers}, warmup=True)
+run_server(host="127.0.0.1", port={port}, workers={workers}, warmup={warmup})
 """
 
 
@@ -82,7 +82,7 @@ def server_pool(model_collection_directory, trained_model_directories, tmp_path)
         # only the arbiter would orphan three live worker processes
         proc = subprocess.Popen(
             [sys.executable, "-c",
-             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=3)],
+             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=3, warmup=True)],
             env=env,
             stdout=subprocess.DEVNULL,
             stderr=errfh,
@@ -212,7 +212,7 @@ def test_boot_failure_during_slow_warmup_trips_throttle(tmp_path):
         # inline); still only ~6 boot-death cycles to the throttle
         proc = subprocess.Popen(
             [sys.executable, "-c",
-             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=2)],
+             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=2, warmup=True)],
             env=env, stdout=subprocess.DEVNULL, stderr=errfh,
             start_new_session=True,
         )
@@ -224,6 +224,97 @@ def test_boot_failure_during_slow_warmup_trips_throttle(tmp_path):
         rc = proc.wait(timeout=420)
         assert rc != 0
         assert "boot" in errlog.read_text()
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+def test_arbiter_drain_on_sigterm_finishes_inflight(
+    model_collection_directory, trained_model_directories, tmp_path,
+    gordo_project, gordo_name, X_payload,
+):
+    """Graceful drain (PR 3): SIGTERM to the arbiter forwards TERM to the
+    workers, which stop accepting, FINISH the in-flight request (a fault
+    plan wedges it for several seconds), and exit — the whole pool shuts
+    down rc=0 and the listener is closed afterwards."""
+    import threading
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    port = _free_port()
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "MODEL_COLLECTION_DIR": model_collection_directory,
+        "PROJECT": "gordo-test",
+        # hold the in-flight request inside the handler long enough that
+        # SIGTERM provably lands mid-request (first predict only)
+        "GORDO_TPU_FAULT_PLAN": json.dumps(
+            {"rules": [{"site": "serve_predict", "times": 1,
+                        "error": "wedge", "seconds": 6}]}
+        ),
+        # the wedged request also pays its first-predict compile; the
+        # drain budget must outlast it on a loaded CPU host
+        "GORDO_TPU_DRAIN_S": "180",
+    }
+    errlog = tmp_path / "drain-stderr.log"
+    with open(errlog, "w") as errfh:
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             _SERVER_SCRIPT.format(repo=REPO, port=port, workers=2,
+                                   warmup=False)],
+            env=env, stdout=subprocess.DEVNULL, stderr=errfh,
+            start_new_session=True,
+        )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        assert _wait_for(
+            lambda: _get(f"{base}/healthcheck", timeout=5)[0] == 200,
+            timeout=120,
+        ), f"pool never came up: {errlog.read_text()[-2000:]}"
+
+        url = (
+            f"{base}/gordo/v0/{gordo_project}/{gordo_name}"
+            f"/anomaly/prediction"
+        )
+        frame = dataframe_to_dict(X_payload)
+        result = {}
+
+        def inflight():
+            try:
+                result["resp"] = _post_json(
+                    url, {"X": frame, "y": frame}, timeout=240
+                )
+            except BaseException as exc:  # noqa: BLE001
+                result["error"] = exc
+
+        t = threading.Thread(target=inflight)
+        t.start()
+        time.sleep(2.0)  # the request is wedged inside a worker
+        assert proc.poll() is None
+        os.kill(proc.pid, signal.SIGTERM)  # the ARBITER only
+
+        t.join(timeout=240)
+        assert not t.is_alive(), "in-flight request never completed"
+        assert "error" not in result, (
+            f"in-flight request cut during drain: {result['error']!r}; "
+            f"stderr: {errlog.read_text()[-2000:]}"
+        )
+        status, body = result["resp"]
+        assert status == 200
+        assert json.loads(body)["data"]
+
+        # the whole pool exits cleanly within the drain budget
+        rc = proc.wait(timeout=240)
+        assert rc == 0, f"stderr: {errlog.read_text()[-2000:]}"
+        assert "draining" in errlog.read_text()
+
+        # listener closed: nothing accepts on the port anymore
+        with pytest.raises((urllib.error.URLError, OSError)):
+            _get(f"{base}/healthcheck", timeout=5)
     finally:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
