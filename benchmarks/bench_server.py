@@ -110,11 +110,20 @@ def _parquet_body(X, y):
     return body, f"multipart/form-data; boundary={boundary}"
 
 
-def run(rounds: int, samples: int, n_tags: int) -> int:
+def _apply_codec(codec):
+    """Pin the serving codec for this process (the --codec A/B flag):
+    ``fast`` forces the numpy-native path, ``pandas`` restores the
+    reference path, None leaves the env default (fast)."""
+    if codec:
+        os.environ["GORDO_TPU_FAST_CODEC"] = "1" if codec == "fast" else "0"
+
+
+def run(rounds: int, samples: int, n_tags: int, codec=None) -> int:
     import numpy as np
 
     from gordo_tpu.server.server import build_app
 
+    _apply_codec(codec)
     collection = _build_collection(n_tags)
     app = build_app({"MODEL_COLLECTION_DIR": collection})
     client = app.test_client()
@@ -155,6 +164,7 @@ def run(rounds: int, samples: int, n_tags: int) -> int:
                 {
                     "endpoint": endpoint,
                     "format": fmt,
+                    "codec": codec or "default",
                     "rounds": rounds,
                     "samples_per_post": samples,
                     "p50_ms": round(times[len(times) // 2] * 1e3, 3),
@@ -175,6 +185,7 @@ def run_concurrent(
     n_models: int,
     arch: str = "hourglass",
     quiet: bool = False,
+    codec=None,
 ) -> dict:
     """
     Cross-model batching A/B: ``users`` threads POST anomaly requests round-
@@ -192,6 +203,7 @@ def run_concurrent(
     from gordo_tpu.server import batcher as batcher_mod
     from gordo_tpu.server.server import build_app
 
+    _apply_codec(codec)
     collection = _build_collection(n_tags, n_models=n_models, arch=arch)
     app = build_app({"MODEL_COLLECTION_DIR": collection})
     client = app.test_client()
@@ -285,6 +297,7 @@ def run_concurrent(
     auto = drive("auto")
     speedup = batched["samples_per_sec"] / max(direct["samples_per_sec"], 1e-9)
     result = {
+        "codec": codec or "default",
         "direct": direct,
         "batched": batched,
         "auto": auto,
@@ -316,6 +329,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--arch", choices=sorted(_MODEL_BLOCKS), default="hourglass"
     )
+    parser.add_argument(
+        "--codec",
+        choices=("fast", "pandas"),
+        default=None,
+        help="Pin the serving codec (GORDO_TPU_FAST_CODEC) for an A/B: "
+        "'fast' = numpy-native path, 'pandas' = reference path; default "
+        "leaves the env setting (fast)",
+    )
     args = parser.parse_args(argv)
     if args.concurrency > 0:
         run_concurrent(
@@ -325,9 +346,10 @@ def main(argv=None) -> int:
             args.concurrency,
             args.models,
             arch=args.arch,
+            codec=args.codec,
         )
         return 0
-    return run(args.rounds, args.samples, args.tags)
+    return run(args.rounds, args.samples, args.tags, codec=args.codec)
 
 
 if __name__ == "__main__":

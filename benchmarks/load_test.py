@@ -43,13 +43,14 @@ def discover(host: str, project: str, machine: str = None):
     return machine, tags
 
 
-def worker(url: str, body: bytes, stop_at: float, out: list, errors: list):
+def worker(
+    url: str, body: bytes, stop_at: float, out: list, errors: list,
+    headers: dict,
+):
     while time.monotonic() < stop_at:
         start = time.monotonic()
         try:
-            req = urllib.request.Request(
-                url, data=body, headers={"Content-Type": "application/json"}
-            )
+            req = urllib.request.Request(url, data=body, headers=headers)
             with urllib.request.urlopen(req, timeout=60) as resp:
                 resp.read()
         except urllib.error.HTTPError as exc:
@@ -70,6 +71,14 @@ def main(argv=None) -> int:
     parser.add_argument("--users", type=int, default=8)
     parser.add_argument("--duration", type=float, default=30.0)
     parser.add_argument("--samples", type=int, default=100)
+    parser.add_argument(
+        "--codec",
+        choices=("fast", "pandas"),
+        default=None,
+        help="A/B the live server's codec per request via the "
+        "X-Gordo-Codec header ('pandas' forces the reference path; only "
+        "effective while the server's GORDO_TPU_FAST_CODEC gate is on)",
+    )
     args = parser.parse_args(argv)
 
     machine, tags = discover(args.host, args.project, args.machine)
@@ -78,12 +87,13 @@ def main(argv=None) -> int:
     X = [[random.random() for _ in tags] for _ in range(args.samples)]
     body = json.dumps({"X": X, "y": X}).encode()
     url = f"{args.host}/gordo/v0/{args.project}/{machine}/anomaly/prediction"
+    headers = {"Content-Type": "application/json"}
+    if args.codec:
+        headers["X-Gordo-Codec"] = args.codec
 
     # warmup one request so compile/model-load cost isn't in the measurement
     try:
-        req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"}
-        )
+        req = urllib.request.Request(url, data=body, headers=headers)
         urllib.request.urlopen(req, timeout=120).read()
     except Exception as exc:  # noqa: BLE001
         print(json.dumps({"error": f"warmup request failed: {exc!r}"}))
@@ -94,7 +104,9 @@ def main(argv=None) -> int:
     stop_at = time.monotonic() + args.duration
     threads = [
         threading.Thread(
-            target=worker, args=(url, body, stop_at, times, errors), daemon=True
+            target=worker,
+            args=(url, body, stop_at, times, errors, headers),
+            daemon=True,
         )
         for _ in range(args.users)
     ]
@@ -113,6 +125,7 @@ def main(argv=None) -> int:
         json.dumps(
             {
                 "machine": machine,
+                "codec": args.codec or "default",
                 "users": args.users,
                 "duration_sec": round(wall, 2),
                 "requests": len(times),

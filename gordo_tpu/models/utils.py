@@ -17,6 +17,37 @@ import pandas as pd
 from gordo_tpu.dataset.sensor_tag import SensorTag
 
 
+def fast_transform(transformer, values: np.ndarray) -> np.ndarray:
+    """``transformer.transform`` minus sklearn's per-call validation for
+    the ubiquitous fitted MinMaxScaler (values * scale_ + min_ — sklearn's
+    exact formula); any other transformer goes through .transform."""
+    from sklearn.preprocessing import MinMaxScaler
+
+    if (
+        type(transformer) is MinMaxScaler
+        and hasattr(transformer, "scale_")
+        and not getattr(transformer, "clip", False)
+    ):
+        return values * transformer.scale_ + transformer.min_
+    return np.asarray(transformer.transform(values))
+
+
+def pipeline_predict(model, values: np.ndarray) -> np.ndarray:
+    """Serve-path predict: walk an sklearn Pipeline's steps directly
+    (transform chain + final predict — exactly what Pipeline.predict
+    does) without its per-step routing/validation plumbing, which costs
+    ~0.3 ms per call against a sub-5-ms latency budget. Non-pipelines
+    predict as-is."""
+    steps = getattr(model, "steps", None)
+    if not isinstance(steps, list) or not steps:
+        return model.predict(values)
+    for _, transformer in steps[:-1]:
+        if transformer is None or isinstance(transformer, str):
+            continue  # 'passthrough' placeholders
+        values = fast_transform(transformer, values)
+    return steps[-1][1].predict(values)
+
+
 def metric_wrapper(metric, scaler=None):
     """
     Wrap a metric so it tolerates model output shorter than y (windowed
@@ -91,8 +122,17 @@ def assemble_multiindex_frame(
     numeric_block = pd.DataFrame(np.hstack(blocks), index=index)
     numeric_block.columns = pd.RangeIndex(2, 2 + numeric_block.shape[1])
     data = pd.concat((time_block, numeric_block), axis=1, copy=False)
-    data.columns = pd.MultiIndex.from_tuples(tuples)
+    data.columns = _multiindex_for(tuple(tuples))
     return data
+
+
+@functools.lru_cache(maxsize=1024)
+def _multiindex_for(tuples: tuple) -> pd.MultiIndex:
+    """Cached MultiIndex construction: a serving model emits the same
+    column tuples on every request, and from_tuples costs ~0.2 ms —
+    measurable against a sub-5-ms latency budget. Indexes are immutable,
+    so sharing one across response frames is safe."""
+    return pd.MultiIndex.from_tuples(tuples)
 
 
 def timestamp_columns(index, frequency: Optional[timedelta]):

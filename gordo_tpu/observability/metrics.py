@@ -158,6 +158,22 @@ WATCHDOG_TRIPS = telemetry.counter(
     "Healthcheck probes answered 503 because the batcher dispatcher has "
     "been stuck in one device call past GORDO_TPU_WATCHDOG_S",
 )
+# --------------------------------------------------- serving codec (PR 4)
+# wired by server/views.py around server/fast_codec.py
+FAST_CODEC = telemetry.counter(
+    "gordo_server_fast_codec_total",
+    "Request frames that took the numpy-native codec fast path, by op "
+    "(decode: payload parsed straight to a contiguous ndarray; encode: "
+    "response serialized off the frame's blocks)",
+    ("op",),
+)
+FAST_CODEC_FALLBACK = telemetry.counter(
+    "gordo_server_fast_codec_fallback_total",
+    "Request frames that fell back to the pandas codec path while the fast "
+    "codec was enabled (multi-level / ragged / non-numeric payloads, "
+    "non-canonical response frames), by op",
+    ("op",),
+)
 MODEL_LOAD_FAILURES = telemetry.counter(
     "gordo_server_model_load_failures_total",
     "Model-load failures in the serving path, by kind: fresh (a real "

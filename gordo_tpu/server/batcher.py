@@ -230,6 +230,13 @@ class CrossModelBatcher:
         self._calibrating: set = set()
         # (spec, shape) pairs whose abandonment has been logged already
         self._abandon_logged: set = set()
+        # reusable stacking buffers, keyed by (input shape, dtype, fuse
+        # bucket): _device_call used to np.stack a fresh (b_pad, *shape)
+        # array plus an index vector per fused call — steady-state serving
+        # re-allocates the identical buffers thousands of times a second.
+        # Only the dispatcher thread fills/ships them, and jax copies host
+        # inputs at dispatch, so reuse across calls is safe.
+        self._stack_buffers: Dict[Tuple, Tuple[np.ndarray, np.ndarray]] = {}
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
@@ -532,6 +539,33 @@ class CrossModelBatcher:
             self._execute(spec, items[:mid])
             self._execute(spec, items[mid:])
 
+    def _stacked_inputs(
+        self, items: List[_Item], slots: List[int], b_pad: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fill (and reuse) the per-fuse-width stacking buffers instead of
+        allocating a fresh (b_pad, *shape) array + index vector per call.
+        Pad lanes repeat item 0 (same values the old np.stack shipped)."""
+        sample = items[0].X_pad
+        key = (sample.shape, sample.dtype.str, b_pad)
+        pair = self._stack_buffers.get(key)
+        if pair is None:
+            if len(self._stack_buffers) >= 64:
+                # bounded: shapes are bucketed, but a pathological client
+                # mix must not grow this into a leak
+                self._stack_buffers.clear()
+            pair = (
+                np.empty((b_pad,) + sample.shape, dtype=sample.dtype),
+                np.empty(b_pad, dtype=np.int32),
+            )
+            self._stack_buffers[key] = pair
+        X, idx = pair
+        for i, item in enumerate(items):
+            X[i] = item.X_pad
+        X[len(items):] = sample
+        idx[: len(slots)] = slots
+        idx[len(slots):] = slots[0]
+        return X, idx
+
     def _device_call(self, spec, items: List[_Item]):
         from gordo_tpu.server import resilience
         from gordo_tpu.util import faults
@@ -560,11 +594,7 @@ class CrossModelBatcher:
             # reset point into the old bank — re-resolve (a second pass can't
             # reset again: max_batch << MAX_MODELS)
             slots = [bank.slot_of(it.params) for it in items]
-        idx = np.asarray(slots + [slots[0]] * (b_pad - n), dtype=np.int32)
-        X = np.stack(
-            [it.X_pad for it in items]
-            + [items[0].X_pad] * (b_pad - n)
-        )
+        X, idx = self._stacked_inputs(items, slots, b_pad)
         # the busy window feeds the device watchdog: a wedged call here is
         # what flips /healthcheck to 503 (resilience.stuck_device_call_s)
         self._busy_since = time.monotonic()

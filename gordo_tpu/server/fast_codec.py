@@ -1,0 +1,281 @@
+"""
+Numpy-native serving codec: the hot-path decode/encode fast lane.
+
+BENCH_r05 measured the anomaly-POST p50 at 9.6 ms against a 0.007 ms
+device/d2h floor — >90% of serving latency was host-side JSON→pandas→JSON
+work, not compute. This module short-circuits that work for the canonical
+request/response shapes while guaranteeing **byte-identical JSON** to the
+pandas path (asserted by tests/gordo_tpu/test_fast_codec.py):
+
+- decode: a rectangular ``X`` (list-of-lists) or a flat column dict
+  (``{tag: {key: value}}`` — :func:`server.utils.dataframe_to_dict` output)
+  parses straight into one contiguous float64 ndarray with single-pass
+  shape validation; no ``pd.DataFrame.from_dict``, no ``pd.concat``.
+  Multi-level / ragged / non-numeric payloads return ``None`` and take the
+  pandas path unchanged.
+- encode: a response frame serializes block-by-block off its float64
+  storage — index keys stringified once, NaN/Inf → ``null`` via one
+  vectorized ``np.isfinite`` pass, float columns written through the C
+  ``json`` encoder (identical shortest-repr formatting) instead of
+  ``to_numpy(dtype=object)`` + a recursive sanitize + generic dumps.
+  ``orjson`` is used for string escaping when importable; the stdlib C
+  escaper is the fallback (this image has no orjson wheel).
+
+Gate: ``GORDO_TPU_FAST_CODEC`` (default **on**; ``0`` restores the pandas
+path exactly). Per-request override: ``X-Gordo-Codec: pandas|fast`` header
+(honored only while the env gate is on) — this is what gives
+``benchmarks/load_test.py --codec`` a server-side A/B without a redeploy.
+Usage is counted by ``gordo_server_fast_codec_total`` /
+``gordo_server_fast_codec_fallback_total`` (bridged into ``/metrics``).
+"""
+
+import json
+import logging
+import os
+from typing import List, Optional
+
+import dateutil.parser
+import numpy as np
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - environment-dependent
+    from orjson import dumps as _orjson_dumps
+
+    def _escape(s: str) -> str:
+        return _orjson_dumps(s).decode()
+
+except ImportError:
+    from json.encoder import encode_basestring_ascii as _escape
+
+_dumps = json.dumps
+_add = str.__add__
+_join = ", ".join
+
+
+def enabled() -> bool:
+    """The process-level gate: ``GORDO_TPU_FAST_CODEC`` unset/``1`` = on."""
+    return os.environ.get("GORDO_TPU_FAST_CODEC", "1").lower() not in (
+        "0",
+        "false",
+        "no",
+    )
+
+
+def request_enabled(request) -> bool:
+    """Whether THIS request takes the fast lane: the env gate, minus a
+    per-request ``X-Gordo-Codec: pandas`` opt-out (the load-test A/B
+    switch). ``GORDO_TPU_FAST_CODEC=0`` is absolute — the header cannot
+    re-enable a disabled codec."""
+    if not enabled():
+        return False
+    return request.headers.get("X-Gordo-Codec", "").lower() != "pandas"
+
+
+# ------------------------------------------------------------------- decode
+def _parse_index(keys: List[str]) -> Optional[pd.Index]:
+    """The exact index-coercion chain of ``dataframe_from_dict`` (bulk
+    ISO8601 → per-element isoparse → int), so fast- and pandas-decoded
+    frames carry interchangeable indexes."""
+    idx = pd.Index(keys)
+    try:
+        return pd.to_datetime(idx, format="ISO8601")
+    except (TypeError, ValueError):
+        pass
+    try:
+        return idx.map(dateutil.parser.isoparse)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return idx.map(int)
+    except (TypeError, ValueError):
+        return None
+
+
+def decode_dataframe(data) -> Optional[pd.DataFrame]:
+    """Parse a canonical payload into a DataFrame via one contiguous
+    float64 ndarray; ``None`` means "not canonical — use the pandas path".
+
+    Canonical shapes: a rectangular list-of-lists (row-major), or a flat
+    dict of columns ``{name: {index_key: value}}`` whose columns share one
+    key sequence. ``null`` cells become NaN exactly like pandas.
+    """
+    if isinstance(data, list):
+        try:
+            arr = np.asarray(data, dtype=np.float64)
+        except (TypeError, ValueError):
+            return None
+        if arr.ndim != 2 or arr.shape[0] == 0:
+            return None
+        # RangeIndex here vs the pandas path's int64 Index: identical keys
+        # ("0".."n-1") on the wire, identical .values for the model
+        return pd.DataFrame(arr)
+    if not isinstance(data, dict) or not data:
+        return None
+    first_keys: Optional[list] = None
+    columns = []
+    for name, col in data.items():
+        if not isinstance(col, dict) or not col:
+            return None
+        if first_keys is None:
+            first_keys = list(col)
+        elif len(col) != len(first_keys) or list(col) != first_keys:
+            # ragged / reordered columns: pandas aligns these by label —
+            # genuinely irregular, not worth mirroring here
+            return None
+        try:
+            values = np.array(list(col.values()), dtype=np.float64)
+        except (TypeError, ValueError):
+            # non-numeric cells, or nested dicts (a multi-level payload)
+            return None
+        if values.ndim != 1:
+            return None
+        columns.append(values)
+    index = _parse_index(first_keys)
+    if index is None:
+        return None
+    frame = pd.DataFrame(
+        np.column_stack(columns), index=index, columns=list(data), copy=False
+    )
+    if not frame.index.is_monotonic_increasing:
+        frame.sort_index(inplace=True)
+    return frame
+
+
+# ------------------------------------------------------------------- encode
+def _key_prefixes(index: pd.Index) -> Optional[List[str]]:
+    """Pre-escaped ``"<key>": `` fragments, one per row — computed once and
+    shared by every column (the pandas path re-builds a dict per column)."""
+    if isinstance(index, pd.DatetimeIndex):
+        return [_escape(s) + ": " for s in index.astype(str)]
+    prefixes = []
+    for key in index.tolist():
+        kind = type(key)
+        if kind is int:
+            prefixes.append('"%d": ' % key)
+        elif kind is str:
+            prefixes.append(_escape(key) + ": ")
+        else:
+            return None
+    return prefixes
+
+
+def _column_fragments(df: pd.DataFrame, prefixes: List[str]) -> Optional[list]:
+    """Per-column ``{"k": v, ...}`` JSON fragments, in column order,
+    straight off the frame's blocks (no object-dtype conversion)."""
+    fragments: list = [None] * df.shape[1]
+    for block in df._mgr.blocks:
+        values = block.values
+        if not isinstance(values, np.ndarray):
+            return None  # extension arrays: pandas path handles them
+        kind = values.dtype.kind
+        positions = block.mgr_locs.as_array
+        if kind == "f":
+            finite = np.isfinite(values)
+            clean = finite.all(axis=1)
+            rows = values.tolist()
+            for i, pos in enumerate(positions):
+                if clean[i]:
+                    # C-encoder list dump then split: float shortest-repr
+                    # at C speed, identical bytes to dict encoding
+                    parts = _dumps(rows[i])[1:-1].split(", ")
+                else:
+                    parts = [
+                        repr(v) if ok else "null"
+                        for v, ok in zip(rows[i], finite[i])
+                    ]
+                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
+        elif kind in "iu":
+            rows = values.tolist()
+            for i, pos in enumerate(positions):
+                parts = _dumps(rows[i])[1:-1].split(", ")
+                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
+        elif kind == "b":
+            rows = values.tolist()
+            for i, pos in enumerate(positions):
+                parts = ["true" if v else "false" for v in rows[i]]
+                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
+        elif kind == "O":
+            rows = values.tolist()
+            for i, pos in enumerate(positions):
+                parts = []
+                for v in rows[i]:
+                    if v is None:
+                        parts.append("null")
+                    elif type(v) is str:
+                        parts.append(_escape(v))
+                    else:
+                        return None  # arbitrary objects: pandas path
+                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
+        else:
+            return None  # datetime64 / timedelta / anything exotic
+    return fragments
+
+
+def _label(value) -> Optional[str]:
+    kind = type(value)
+    if kind is str:
+        return _escape(value)
+    if kind is int:
+        return '"%d"' % value
+    return None
+
+
+def encode_dataframe(df: pd.DataFrame) -> Optional[str]:
+    """The ``"data"`` JSON fragment — byte-identical to
+    ``simplejson.dumps(dataframe_to_dict(df), ignore_nan=True)`` — or
+    ``None`` when the frame isn't fast-serializable (the caller then takes
+    the pandas path, which is always correct)."""
+    try:
+        index = df.index
+        if len(index) == 0 or not index.is_unique or not df.columns.is_unique:
+            # dict(zip(...)) / setdefault deduplicate repeated keys;
+            # mirroring that here isn't worth it for a degenerate frame
+            return None
+        prefixes = _key_prefixes(index)
+        if prefixes is None:
+            return None
+        fragments = _column_fragments(df, prefixes)
+        if fragments is None:
+            return None
+        out = []
+        if isinstance(df.columns, pd.MultiIndex):
+            current = None
+            subs: list = []
+            closed = set()
+            for (top, sub), fragment in zip(df.columns, fragments):
+                top_l, sub_l = _label(top), _label(sub)
+                if top_l is None or sub_l is None:
+                    return None
+                if top != current:
+                    if top in closed:
+                        # non-contiguous top-level group: the dict path
+                        # merges it back into the earlier group — bail
+                        return None
+                    if current is not None:
+                        closed.add(current)
+                        out.append(_label(current) + ": {" + _join(subs) + "}")
+                    current, subs = top, []
+                subs.append(sub_l + ": " + fragment)
+            out.append(_label(current) + ": {" + _join(subs) + "}")
+        else:
+            for name, fragment in zip(df.columns, fragments):
+                name_l = _label(name)
+                if name_l is None:
+                    return None
+                out.append(name_l + ": " + fragment)
+        return "{" + _join(out) + "}"
+    except Exception:  # noqa: BLE001 — the fallback is always correct;
+        # a fast-path crash must degrade to the pandas path, not a 500
+        logger.debug("fast-codec encode bailed", exc_info=True)
+        return None
+
+
+def splice_response_body(data_fragment: str, rest_json: str) -> str:
+    """Assemble ``{"data": <fragment>, <rest...>}`` from the pre-encoded
+    data fragment and the (simplejson-encoded) remaining payload fields,
+    preserving the exact separators ``json.dumps`` would emit."""
+    if rest_json == "{}":
+        return '{"data": ' + data_fragment + "}"
+    return '{"data": ' + data_fragment + ", " + rest_json[1:]

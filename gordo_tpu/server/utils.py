@@ -254,6 +254,32 @@ def load_metadata(directory: str, name: str) -> dict:
     return pickle.loads(zlib.decompress(_load_compressed_metadata(directory, name)))
 
 
+@lru_cache(maxsize=4096)
+def load_serving_info(directory: str, name: str):
+    """``(tags, target_tags, frequency)`` for one artifact, cached.
+
+    Every prediction request needs the model's tag lists (column
+    verification) and resolution (response 'end' timestamps) — but only
+    the compressed metadata pickle was cached, so each request re-paid a
+    zlib+unpickle plus two tag normalizations (~0.5 ms of the serving
+    p50). Artifacts are immutable per (directory, name), so the derived
+    tuple caches safely; memory is three small tuples per model against
+    the compressed blob already held."""
+    from gordo_tpu.dataset.sensor_tag import normalize_sensor_tags
+
+    dataset_meta = load_metadata(directory, name)["dataset"]
+    asset = dataset_meta.get("asset")
+    tag_list = dataset_meta.get("tag_list") or dataset_meta.get("tags") or []
+    tags = tuple(normalize_sensor_tags(tag_list, asset=asset))
+    target = dataset_meta.get("target_tag_list")
+    target_tags = tuple(normalize_sensor_tags(target, asset=asset)) if target else tags
+    frequency = pd.tseries.frequencies.to_offset(
+        dataset_meta.get("resolution", "10min")
+    )
+    return tags, target_tags, frequency
+
+
 def clear_model_caches():
     load_model.cache_clear()
     _load_compressed_metadata.cache_clear()
+    load_serving_info.cache_clear()

@@ -8,22 +8,29 @@ prediction, anomaly prediction (smoothed columns dropped unless
 Implemented as plain functions over a per-request context (no flask.g).
 """
 
+import datetime
 import logging
 import os
 import timeit
 import traceback
 
+import numpy as np
 import pandas as pd
 from werkzeug.exceptions import NotFound
 from werkzeug.wrappers import Response
 
 from gordo_tpu import __version__, serializer
-from gordo_tpu.dataset.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
-from gordo_tpu.server import model_io
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.server import fast_codec, model_io
 from gordo_tpu.server import resilience
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.util import faults
+
+try:
+    import simplejson
+except ImportError:  # pragma: no cover - environment-dependent
+    from gordo_tpu.util import _simplejson as simplejson
 
 logger = logging.getLogger(__name__)
 
@@ -35,19 +42,55 @@ DELETED_FROM_RESPONSE_COLUMNS = (
 )
 
 
-def json_response(ctx, payload: dict, status: int = 200) -> Response:
-    try:
-        import simplejson
-    except ImportError:  # pragma: no cover - environment-dependent
-        from gordo_tpu.util import _simplejson as simplejson
+def json_serializer_default(obj):
+    """The ``default=`` hook for response serialization.
 
+    This used to be a blanket ``default=str``, which silently stringified
+    ANY unserializable object into a response body (a bug that ships bad
+    payloads instead of failing the request). Only the types with a known,
+    intended wire form are converted; everything else raises so the error
+    surfaces as a 500 in tests instead of corrupt data in production.
+    """
+    if isinstance(obj, (datetime.datetime, datetime.date)):
+        return str(obj)
+    if isinstance(obj, np.generic):  # numpy scalars leak from metadata
+        return obj.item()
+    raise TypeError(
+        f"Object of type {type(obj).__name__} is not JSON serializable "
+        f"(refusing to silently stringify it into a response)"
+    )
+
+
+def json_response(ctx, payload: dict, status: int = 200) -> Response:
     payload = dict(payload)
     payload["revision"] = ctx.revision
     return Response(
-        simplejson.dumps(payload, ignore_nan=True, default=str),
+        simplejson.dumps(payload, ignore_nan=True, default=json_serializer_default),
         status=status,
         mimetype="application/json",
     )
+
+
+def frame_response(ctx, request, df: pd.DataFrame, extra: dict) -> Response:
+    """Serialize a prediction response frame as ``{"data": ..., **extra,
+    "revision": ...}`` — through the numpy-native fast codec when enabled
+    (byte-identical output), else the pandas dict path."""
+    if fast_codec.request_enabled(request):
+        fragment = fast_codec.encode_dataframe(df)
+        if fragment is not None:
+            metric_catalog.FAST_CODEC.labels(op="encode").inc()
+            rest = dict(extra)
+            rest["revision"] = ctx.revision
+            body = fast_codec.splice_response_body(
+                fragment,
+                simplejson.dumps(
+                    rest, ignore_nan=True, default=json_serializer_default
+                ),
+            )
+            return Response(body, status=200, mimetype="application/json")
+        metric_catalog.FAST_CODEC_FALLBACK.labels(op="encode").inc()
+    payload = {"data": server_utils.dataframe_to_dict(df), **extra}
+    return json_response(ctx, payload, 200)
 
 
 class ModelContext:
@@ -58,6 +101,7 @@ class ModelContext:
         self.gordo_name = gordo_name
         self._model = None
         self._metadata = None
+        self._serving_info = None
 
     @property
     def model(self):
@@ -82,24 +126,41 @@ class ModelContext:
         return self._metadata
 
     @property
+    def serving_info(self):
+        """(tags, target_tags, frequency), from the per-artifact cache —
+        one zlib+unpickle+normalize per model, not per request."""
+        if self._serving_info is None:
+            try:
+                self._serving_info = server_utils.load_serving_info(
+                    self.ctx.collection_dir, self.gordo_name
+                )
+            except FileNotFoundError:
+                raise NotFound(f"No model found for '{self.gordo_name}'")
+        return self._serving_info
+
+    @property
     def tags(self):
-        dataset_meta = self.metadata["dataset"]
-        tag_list = dataset_meta.get("tag_list") or dataset_meta.get("tags") or []
-        return normalize_sensor_tags(tag_list, asset=dataset_meta.get("asset"))
+        return self.serving_info[0]
 
     @property
     def target_tags(self):
-        dataset_meta = self.metadata["dataset"]
-        target = dataset_meta.get("target_tag_list")
-        if target:
-            return normalize_sensor_tags(target, asset=dataset_meta.get("asset"))
-        return self.tags
+        return self.serving_info[1]
 
     @property
     def frequency(self):
-        return pd.tseries.frequencies.to_offset(
-            self.metadata["dataset"].get("resolution", "10min")
-        )
+        return self.serving_info[2]
+
+
+def _decode_frame(data, fast: bool) -> pd.DataFrame:
+    """One request frame (X or y): the numpy-native fast lane when the
+    payload is canonical, the pandas path otherwise — each counted."""
+    if fast:
+        frame = fast_codec.decode_dataframe(data)
+        if frame is not None:
+            metric_catalog.FAST_CODEC.labels(op="decode").inc()
+            return frame
+        metric_catalog.FAST_CODEC_FALLBACK.labels(op="decode").inc()
+    return server_utils.dataframe_from_dict(data)
 
 
 def extract_X_y(request, mc: ModelContext):
@@ -113,10 +174,11 @@ def extract_X_y(request, mc: ModelContext):
         raise server_utils.BadDataFrame('Cannot predict without "X"')
 
     if payload is not None:
-        X = server_utils.dataframe_from_dict(payload["X"])
+        fast = fast_codec.request_enabled(request)
+        X = _decode_frame(payload["X"], fast)
         y = payload.get("y")
         if y is not None:
-            y = server_utils.dataframe_from_dict(y)
+            y = _decode_frame(y, fast)
     else:
         X = server_utils.dataframe_from_parquet_bytes(request.files["X"].read())
         y = request.files.get("y")
@@ -224,9 +286,11 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
                 server_utils.dataframe_into_parquet_bytes(data),
                 mimetype="application/octet-stream",
             )
-        context["data"] = server_utils.dataframe_to_dict(data)
-    context["time-seconds"] = f"{timeit.default_timer() - start:.4f}"
-    return json_response(ctx, context, 200)
+        # serialization happens INSIDE the encode phase so Server-Timing's
+        # encode_s covers the full response-assembly cost (the dumps used
+        # to run untimed after the phase closed)
+        context["time-seconds"] = f"{timeit.default_timer() - start:.4f}"
+        return frame_response(ctx, request, data, context)
 
 
 def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
@@ -297,7 +361,8 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
                 for c in anomaly_df.columns.get_level_values(0).unique()
                 if c in DELETED_FROM_RESPONSE_COLUMNS
             ]
-            anomaly_df = anomaly_df.drop(columns=drop, level=0)
+            if drop:  # drop() copies the frame even for an empty list
+                anomaly_df = anomaly_df.drop(columns=drop, level=0)
 
         if request.args.get("format") == "parquet":
             return Response(
@@ -305,10 +370,9 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
                 mimetype="application/octet-stream",
             )
         context = {
-            "data": server_utils.dataframe_to_dict(anomaly_df),
             "time-seconds": f"{timeit.default_timer() - start_time:.4f}",
         }
-    return json_response(ctx, context, 200)
+        return frame_response(ctx, request, anomaly_df, context)
 
 
 def metadata_view(ctx, request, gordo_project: str, gordo_name: str) -> Response:

@@ -85,6 +85,31 @@ def test_mixed_shapes_grouped_separately(models):
     assert outputs["l1"].shape == (200, 4)
 
 
+def test_stack_buffers_reused_across_device_calls(models):
+    """The per-fuse-width stacking buffers are allocated once and reused:
+    steady-state serving must not re-allocate a (batch, …) array + index
+    vector on every fused call."""
+    b = CrossModelBatcher(window_ms=0, max_batch=8)
+    rng = np.random.RandomState(2)
+    X = rng.rand(30, 4).astype(np.float32)
+
+    first = b.submit(models[0].spec_, models[0].params_, X)
+    assert len(b._stack_buffers) == 1
+    buffers_after_first = {k: (id(v[0]), id(v[1])) for k, v in b._stack_buffers.items()}
+
+    second = b.submit(models[0].spec_, models[0].params_, X)
+    assert {
+        k: (id(v[0]), id(v[1])) for k, v in b._stack_buffers.items()
+    } == buffers_after_first  # same arrays, not reallocations
+    np.testing.assert_allclose(first, models[0].predict(X), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(second, first, rtol=0, atol=0)
+
+    # a different padded shape gets its own buffer; the cache stays bounded
+    X_large = rng.rand(300, 4).astype(np.float32)
+    b.submit(models[0].spec_, models[0].params_, X_large)
+    assert len(b._stack_buffers) == 2
+
+
 def test_error_fans_out_to_waiters(models):
     b = CrossModelBatcher(window_ms=5, max_batch=8)
     bad_params = "not-a-pytree-of-arrays"

@@ -103,6 +103,32 @@ def test_bench_recovery_plan():
     assert not bench._rerun_improves({"error": "exit 1"}, hang)
 
 
+def test_bench_budget_skips_sections_but_always_emits_record(
+    capsys, monkeypatch, tmp_path
+):
+    """GORDO_TPU_BENCH_BUDGET_S is a hard wall: with the budget exhausted,
+    no section subprocess is even started, yet the final summary line is
+    still emitted and parseable — a bench run can never end with no
+    parsed output (the round-5 rc=124 failure mode)."""
+    import bench
+
+    monkeypatch.setenv("GORDO_TPU_BENCH_BUDGET_S", "0")
+    # CPU-pinned run: accel_expected False, so no recovery pass either
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("BENCH_DETAIL_FILE", str(tmp_path / "detail.json"))
+    started = []
+    monkeypatch.setattr(
+        bench, "_run_section", lambda *a, **k: started.append(a) or {}
+    )
+    bench.main()
+    assert started == []  # zero budget: no child ever launched
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(record["skipped_for_budget"]) == {
+        "tpu_smoke", "headline", "windowed", "batch_ab",
+    }
+    assert record["value"] is None
+
+
 def test_bench_backend_probe_require_accel(monkeypatch):
     """On a CPU-only backend the probe is 'alive' for fallback purposes
     but NOT for the recovery pass (require_accel) — a host without an

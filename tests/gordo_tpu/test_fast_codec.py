@@ -1,0 +1,388 @@
+"""
+Codec-parity suite for the serving fast path (server/fast_codec.py).
+
+The contract: with `GORDO_TPU_FAST_CODEC` on (the default), every response
+the fast path produces is BYTE-IDENTICAL to what the pandas path would
+have produced, and every payload the fast path cannot handle falls back to
+the pandas path (counted, never erred). Golden payloads cover the
+canonical shapes (rect list, column dict), the fallback shapes
+(multi-level, ragged, non-numeric), and the value edge cases (NaN/Inf,
+string index, int columns).
+"""
+
+import json
+import re
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.server import fast_codec
+from gordo_tpu.server.utils import dataframe_from_dict, dataframe_to_dict
+from gordo_tpu.server.views import json_serializer_default
+from gordo_tpu.util import _simplejson as simplejson
+
+
+def _slow_json(df: pd.DataFrame) -> str:
+    """What the pandas path serializes for one frame."""
+    return simplejson.dumps(
+        dataframe_to_dict(df), ignore_nan=True, default=json_serializer_default
+    )
+
+
+def _assert_encode_parity(df: pd.DataFrame):
+    fragment = fast_codec.encode_dataframe(df)
+    assert fragment is not None, "expected the fast path to handle this frame"
+    assert fragment == _slow_json(df)
+
+
+def _response_frame(index, n_tags=3, with_nan=False) -> pd.DataFrame:
+    """A canonical response-shaped frame: object start/end columns plus a
+    float block under a MultiIndex (models/utils.assemble_multiindex_frame
+    layout)."""
+    n = len(index)
+    rng = np.random.RandomState(0)
+    tuples = [("start", ""), ("end", "")]
+    tuples += [("model-input", f"t-{i}") for i in range(n_tags)]
+    tuples += [("model-output", f"t-{i}") for i in range(n_tags)]
+    tuples += [("total-anomaly-scaled", "")]
+    num = rng.rand(n, len(tuples) - 2)
+    if with_nan:
+        num[0, 0] = np.nan
+        num[-1, -1] = np.inf
+        num[n // 2, 1] = -np.inf
+    if isinstance(index, pd.DatetimeIndex):
+        start = [ts.isoformat() for ts in index]
+        end = [ts.isoformat() for ts in index + pd.Timedelta("10min")]
+    else:
+        start = [None] * n
+        end = [None] * n
+    time_block = pd.DataFrame({0: start, 1: end}, index=index, dtype=object)
+    numeric = pd.DataFrame(num, index=index)
+    numeric.columns = pd.RangeIndex(2, 2 + numeric.shape[1])
+    frame = pd.concat((time_block, numeric), axis=1, copy=False)
+    frame.columns = pd.MultiIndex.from_tuples(tuples)
+    return frame
+
+
+# ------------------------------------------------------------ encode parity
+def test_encode_parity_response_frame_int_index():
+    _assert_encode_parity(_response_frame(pd.RangeIndex(50)))
+
+
+def test_encode_parity_response_frame_datetime_index():
+    idx = pd.date_range("2020-01-01", periods=24, freq="10min", tz="UTC")
+    _assert_encode_parity(_response_frame(idx))
+
+
+def test_encode_parity_nan_and_inf_become_null():
+    frame = _response_frame(pd.RangeIndex(9), with_nan=True)
+    fragment = fast_codec.encode_dataframe(frame)
+    assert fragment == _slow_json(frame)
+    assert "null" in fragment
+    assert "NaN" not in fragment and "Infinity" not in fragment
+
+
+def test_encode_parity_single_level_columns():
+    df = pd.DataFrame(
+        np.random.RandomState(1).rand(20, 3),
+        columns=["a", "b", "c"],
+        index=pd.RangeIndex(20),
+    )
+    _assert_encode_parity(df)
+
+
+def test_encode_parity_string_index():
+    df = pd.DataFrame(
+        np.random.RandomState(2).rand(5, 2),
+        columns=["x", "y"],
+        index=[f'k-{i}"quote' for i in range(5)],  # escaping must match
+    )
+    _assert_encode_parity(df)
+
+
+def test_encode_parity_int_and_bool_columns():
+    df = pd.DataFrame(
+        {
+            "ints": np.arange(7, dtype=np.int64),
+            "floats": np.linspace(0, 1, 7),
+            "flags": np.arange(7) % 2 == 0,
+        }
+    )
+    _assert_encode_parity(df)
+
+
+def test_encode_parity_doctest_frame():
+    # the dataframe_to_dict doctest frame: MultiIndex + int64 + DatetimeIndex
+    columns = pd.MultiIndex.from_tuples(
+        (f"feature{i}", f"sub-feature-{ii}") for i in range(2) for ii in range(2)
+    )
+    index = pd.date_range("2019-01-01", "2019-02-01", periods=2)
+    df = pd.DataFrame(np.arange(8).reshape((2, 4)), columns=columns, index=index)
+    _assert_encode_parity(df)
+
+
+def test_encode_fallback_shapes():
+    # frames the fast path must refuse (pandas path handles them)
+    dup_index = pd.DataFrame({"a": [1.0, 2.0]}, index=[0, 0])
+    assert fast_codec.encode_dataframe(dup_index) is None
+    empty = pd.DataFrame({"a": []})
+    assert fast_codec.encode_dataframe(empty) is None
+    datetime_col = pd.DataFrame(
+        {"ts": pd.date_range("2020-01-01", periods=3)}
+    )
+    assert fast_codec.encode_dataframe(datetime_col) is None
+    objects = pd.DataFrame({"o": [object(), object()]})
+    assert fast_codec.encode_dataframe(objects) is None
+    # non-contiguous top-level groups merge in the dict path — fast bails
+    scattered = pd.DataFrame(
+        np.random.rand(3, 3),
+        columns=pd.MultiIndex.from_tuples([("a", "x"), ("b", "x"), ("a", "y")]),
+    )
+    assert fast_codec.encode_dataframe(scattered) is None
+
+
+def test_splice_response_body():
+    assert (
+        fast_codec.splice_response_body('{"k": 1}', '{"revision": "r"}')
+        == '{"data": {"k": 1}, "revision": "r"}'
+    )
+    assert fast_codec.splice_response_body('{"k": 1}', "{}") == '{"data": {"k": 1}}'
+
+
+# ------------------------------------------------------------ decode parity
+def _assert_decode_parity(payload):
+    fast = fast_codec.decode_dataframe(payload)
+    assert fast is not None, "expected the fast path to handle this payload"
+    slow = dataframe_from_dict(payload)
+    np.testing.assert_array_equal(fast.to_numpy(), slow.to_numpy())
+    assert list(fast.index) == list(slow.index)
+    assert [str(c) for c in fast.columns] == [str(c) for c in slow.columns]
+    # the serialized keys — what the client sees — must agree exactly
+    assert fast_codec._key_prefixes(fast.index) == fast_codec._key_prefixes(
+        slow.index
+    )
+
+
+def test_decode_parity_rect_list():
+    payload = np.random.RandomState(0).rand(30, 4).tolist()
+    _assert_decode_parity(payload)
+
+
+def test_decode_parity_rect_list_with_nulls():
+    payload = [[1.0, None, 3.0], [None, 5.0, 6.0]]
+    fast = fast_codec.decode_dataframe(payload)
+    slow = dataframe_from_dict(payload)
+    np.testing.assert_array_equal(fast.to_numpy(), slow.to_numpy())
+
+
+def test_decode_parity_column_dict_int_keys():
+    df = pd.DataFrame(
+        np.random.RandomState(3).rand(12, 3), columns=["a", "b", "c"]
+    )
+    payload = json.loads(json.dumps(dataframe_to_dict(df)))
+    _assert_decode_parity(payload)
+
+
+def test_decode_parity_column_dict_datetime_keys():
+    idx = pd.date_range("2020-01-01", periods=8, freq="10min", tz="UTC")
+    df = pd.DataFrame(
+        np.random.RandomState(4).rand(8, 2), columns=["a", "b"], index=idx
+    )
+    payload = json.loads(json.dumps(dataframe_to_dict(df)))
+    _assert_decode_parity(payload)
+
+
+def test_decode_unsorted_keys_sorted_like_pandas():
+    payload = {
+        "a": {"2": 3.0, "0": 1.0, "1": 2.0},
+        "b": {"2": 30.0, "0": 10.0, "1": 20.0},
+    }
+    _assert_decode_parity(payload)
+
+
+def test_decode_fallback_shapes():
+    # multi-level payload (dict of dict of dicts)
+    assert (
+        fast_codec.decode_dataframe({"top": {"sub": {"0": 1.0}}}) is None
+    )
+    # ragged columns
+    assert (
+        fast_codec.decode_dataframe({"a": {"0": 1.0}, "b": {"0": 1.0, "1": 2.0}})
+        is None
+    )
+    # reordered keys across columns
+    assert (
+        fast_codec.decode_dataframe(
+            {"a": {"0": 1.0, "1": 2.0}, "b": {"1": 2.0, "0": 1.0}}
+        )
+        is None
+    )
+    # non-numeric cells
+    assert fast_codec.decode_dataframe({"a": {"0": "oops"}}) is None
+    # scalar dict / empties / ragged rect
+    assert fast_codec.decode_dataframe({"a": 1.0}) is None
+    assert fast_codec.decode_dataframe({}) is None
+    assert fast_codec.decode_dataframe([]) is None
+    assert fast_codec.decode_dataframe([[1.0, 2.0], [3.0]]) is None
+
+
+# --------------------------------------------------------------- e2e parity
+@pytest.fixture(scope="module")
+def app(model_collection_directory, trained_model_directories):
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_model_caches()
+    return build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+
+
+@pytest.fixture(scope="module")
+def client(app):
+    return app.test_client()
+
+
+_TIME_RE = re.compile(rb'"time-seconds": "[0-9.]+"')
+
+
+def _normalized(resp) -> bytes:
+    """Response bytes with the (run-varying) time-seconds value pinned."""
+    return _TIME_RE.sub(b'"time-seconds": "T"', resp.data)
+
+
+def _post_both_codecs(client, path, payload):
+    """POST the same payload through the fast and pandas codecs; both must
+    be 200 and byte-identical after pinning time-seconds."""
+    body = json.dumps(payload).encode()
+    fast = client.post(path, data=body, content_type="application/json")
+    slow = client.post(
+        path,
+        data=body,
+        content_type="application/json",
+        headers={"X-Gordo-Codec": "pandas"},
+    )
+    assert fast.status_code == slow.status_code == 200
+    assert _normalized(fast) == _normalized(slow)
+    return fast
+
+
+def test_e2e_rect_list_byte_identical(client, gordo_project, gordo_name):
+    decode_before = metric_catalog.FAST_CODEC.value(op="decode")
+    encode_before = metric_catalog.FAST_CODEC.value(op="encode")
+    X = np.random.RandomState(0).rand(25, 4).tolist()
+    _post_both_codecs(
+        client,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+        {"X": X, "y": X},
+    )
+    # fast request decoded two frames (X and y) and encoded one response
+    assert metric_catalog.FAST_CODEC.value(op="decode") == decode_before + 2
+    assert metric_catalog.FAST_CODEC.value(op="encode") == encode_before + 1
+
+
+def test_e2e_column_dict_byte_identical(
+    client, gordo_project, gordo_name, X_payload
+):
+    payload = json.loads(
+        json.dumps(
+            {"X": dataframe_to_dict(X_payload), "y": dataframe_to_dict(X_payload)}
+        )
+    )
+    _post_both_codecs(
+        client,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+        payload,
+    )
+
+
+def test_e2e_base_prediction_byte_identical(
+    client, gordo_project, gordo_name, X_payload
+):
+    _post_both_codecs(
+        client,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        {"X": dataframe_to_dict(X_payload)},
+    )
+
+
+def test_e2e_all_columns_smoothed_nan_byte_identical(
+    client, gordo_project, second_gordo_name, X_payload
+):
+    """machine-2 smooths over a 144 window → leading NaN rows in the
+    smooth-* blocks: the nulls must round-trip identically."""
+    payload = {
+        "X": dataframe_to_dict(X_payload),
+        "y": dataframe_to_dict(X_payload),
+    }
+    resp = _post_both_codecs(
+        client,
+        f"/gordo/v0/{gordo_project}/{second_gordo_name}/anomaly/prediction"
+        "?all_columns=true",
+        payload,
+    )
+    body = resp.get_json()
+    smooth = [k for k in body["data"] if k.startswith("smooth-")]
+    assert smooth, "expected smoothed columns with all_columns"
+    assert b"null" in resp.data  # the rolling window's leading NaNs
+
+
+def test_e2e_irregular_payload_falls_back_and_400s(
+    client, gordo_project, gordo_name
+):
+    """A multi-level X takes the pandas fallback (counted) and then fails
+    column verification exactly like before."""
+    before = metric_catalog.FAST_CODEC_FALLBACK.value(op="decode")
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        json={"X": {"top": {"sub": {"0": 1.0, "1": 2.0}}}},
+    )
+    assert resp.status_code == 400
+    assert metric_catalog.FAST_CODEC_FALLBACK.value(op="decode") == before + 1
+
+
+def test_env_gate_disables_fast_path(
+    client, gordo_project, gordo_name, monkeypatch
+):
+    """GORDO_TPU_FAST_CODEC=0 restores today's path: no fast counters move,
+    and the header cannot re-enable it."""
+    monkeypatch.setenv("GORDO_TPU_FAST_CODEC", "0")
+    decode_before = metric_catalog.FAST_CODEC.value(op="decode")
+    encode_before = metric_catalog.FAST_CODEC.value(op="encode")
+    X = np.random.RandomState(0).rand(10, 4).tolist()
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        json={"X": X},
+        headers={"X-Gordo-Codec": "fast"},
+    )
+    assert resp.status_code == 200
+    assert metric_catalog.FAST_CODEC.value(op="decode") == decode_before
+    assert metric_catalog.FAST_CODEC.value(op="encode") == encode_before
+
+
+# ------------------------------------------------- json_response default
+def test_json_serializer_default_known_types():
+    import datetime
+
+    assert json_serializer_default(datetime.date(2020, 1, 2)) == "2020-01-02"
+    assert json_serializer_default(
+        datetime.datetime(2020, 1, 2, 3, 4, 5)
+    ) == "2020-01-02 03:04:05"
+    assert json_serializer_default(np.float64(1.5)) == 1.5
+    assert json_serializer_default(np.int64(7)) == 7
+
+
+def test_json_serializer_default_raises_loudly():
+    """default=str used to silently stringify ANY object into responses;
+    unknown types must now raise."""
+
+    class Opaque:
+        pass
+
+    with pytest.raises(TypeError, match="not JSON serializable"):
+        json_serializer_default(Opaque())
+
+    with pytest.raises(TypeError):
+        simplejson.dumps(
+            {"bad": Opaque()}, ignore_nan=True, default=json_serializer_default
+        )
