@@ -83,6 +83,11 @@ def gordo(gordo_ctx: click.Context, **ctx):
         level=getattr(logging, str(gordo_ctx.params.get("log_level")).upper()),
         format="[%(asctime)s] %(levelname)s [%(name)s.%(funcName)s:%(lineno)d] %(message)s",
     )
+    # GORDO_TPU_LOG_FORMAT=json: one JSON object per line, stamped with
+    # the active trace/span ids (observability/logs.py) — no-op otherwise
+    from gordo_tpu.observability import logs
+
+    logs.maybe_configure()
     gordo_ctx.obj = gordo_ctx.params
 
 

@@ -20,6 +20,7 @@ import pandas as pd
 
 from gordo_tpu import serializer
 from gordo_tpu.dataset import GordoBaseDataset
+from gordo_tpu.observability import tracing
 from gordo_tpu.server import utils as server_utils
 from .io import NotFound, _handle_response
 from .utils import PredictionResult
@@ -111,9 +112,20 @@ class Client:
         revision = revision or self.revision
         return {"revision": revision} if revision else {}
 
+    def _trace_headers(self) -> dict:
+        """W3C ``traceparent`` for one outbound call: continue the active
+        trace context when the caller established one (a traced CLI run),
+        else mint a fresh trace per request. The server echoes the trace
+        id back as ``X-Gordo-Trace``, so a client-side failure log names
+        the exact trace to pull from the server's /debug/flight."""
+        ctx = tracing.current() or tracing.fresh_context()
+        return {"traceparent": tracing.format_traceparent(ctx)}
+
     def get_revisions(self) -> dict:
         resp = self.session.get(
-            f"{self.base_url}/revisions", timeout=self.timeout
+            f"{self.base_url}/revisions",
+            headers=self._trace_headers(),
+            timeout=self.timeout,
         )
         return _handle_response(resp, "revisions")
 
@@ -121,6 +133,7 @@ class Client:
         resp = self.session.get(
             f"{self.base_url}/models",
             params=self._params(revision),
+            headers=self._trace_headers(),
             timeout=self.timeout,
         )
         return _handle_response(resp, "model list")
@@ -145,6 +158,7 @@ class Client:
             resp = self.session.get(
                 f"{self.base_url}/{name}/metadata",
                 params=self._params(revision),
+                headers=self._trace_headers(),
                 timeout=self.timeout,
             )
             return _handle_response(resp, f"metadata for {name}").get(
@@ -165,6 +179,7 @@ class Client:
             resp = self.session.get(
                 f"{self.base_url}/{name}/download-model",
                 params=self._params(revision),
+                headers=self._trace_headers(),
                 timeout=self.timeout,
             )
             return serializer.loads(
@@ -336,6 +351,7 @@ class Client:
         url = f"{self.base_url}/{name}/{endpoint}"
         params = dict(self._params(revision), format="parquet") \
             if self.use_parquet else self._params(revision)
+        headers = self._trace_headers()
         if self.use_parquet:
             import io as _io
 
@@ -349,14 +365,16 @@ class Client:
                     server_utils.dataframe_into_parquet_bytes(y)
                 )
             resp = self.session.post(
-                url, files=files, params=params, timeout=self.timeout
+                url, files=files, params=params, headers=headers,
+                timeout=self.timeout,
             )
         else:
             payload = {"X": server_utils.dataframe_to_dict(X)}
             if y is not None:
                 payload["y"] = server_utils.dataframe_to_dict(y)
             resp = self.session.post(
-                url, json=payload, params=params, timeout=self.timeout
+                url, json=payload, params=params, headers=headers,
+                timeout=self.timeout,
             )
         content = _handle_response(resp, f"prediction for {name}")
         if isinstance(content, bytes):

@@ -38,6 +38,12 @@ def _handle_response(resp: Any, resource_name: str = "") -> Any:
             return resp.json()
         return resp.content
     msg = f"Failed to get {resource_name or 'resource'}: {resp.status_code}"
+    # the server's echoed trace id: quoting it in the client-side error is
+    # what lets an operator pull the exact request out of the server's
+    # /debug/flight recorder and trace-correlated logs
+    trace_id = resp.headers.get("X-Gordo-Trace")
+    if trace_id:
+        msg = f"{msg} [trace {trace_id}]"
     try:
         detail = resp.json()
     except Exception:

@@ -510,6 +510,151 @@ def build_dashboard() -> Dict[str, Any]:
     return _dashboard("Gordo TPU builds", "gordo-tpu-builds", panels)
 
 
+def resilience_dashboard() -> Dict[str, Any]:
+    """Serving-resilience dashboard over the PR 3 fault-handling metrics
+    plus the PR 5 flight recorder: load shedding, deadline exhaustion,
+    circuit breakers, the fused-group rescue ladder, the device watchdog,
+    and flight-recorder occupancy. These series live in the telemetry
+    registry (observability/metrics.py, bridged into /metrics) and carry
+    no project label — panels query unselected names, like the build
+    dashboard."""
+    panels = [
+        _timeseries(
+            "Shed & deadline-exceeded requests",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_shed_total[5m])) "
+                    "by (reason)",
+                    "legend": "shed {{reason}}",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_deadline_exceeded_total[5m])) by (where)",
+                    "legend": "deadline {{where}}",
+                },
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="reqps",
+            description=(
+                "Admission-control 503s and X-Gordo-Deadline-Ms 504s: the "
+                "server protecting itself under overload"
+            ),
+        ),
+        _timeseries(
+            "Circuit breakers",
+            [
+                {
+                    "expr": "max(gordo_server_breaker_state) by (model)",
+                    "legend": "state {{model}}",
+                },
+                {
+                    "expr": "sum(rate(gordo_server_breaker_opens_total[5m]))"
+                    " by (model)",
+                    "legend": "opens {{model}}",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_breaker_fast_failures_total[5m])) "
+                    "by (model)",
+                    "legend": "fast-fails {{model}}",
+                },
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            description=(
+                "Per-model breaker state (0 closed / 1 half-open / 2 open) "
+                "with open transitions and fast-failed requests"
+            ),
+        ),
+        _timeseries(
+            "Fused-group rescue ladder",
+            [
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_batcher_abandoned_total[5m]))",
+                    "legend": "abandoned waits",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_group_bisections_total[5m]))",
+                    "legend": "group bisections",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_group_serial_rescues_total[5m]))",
+                    "legend": "serial rescues",
+                },
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+            description=(
+                "The serving twin of the build recovery ladder: deadline-"
+                "abandoned waiters, fused-call bisections, un-fused rescues"
+            ),
+        ),
+        _timeseries(
+            "Model load failures",
+            [
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_model_load_failures_total[5m])) by (kind)",
+                    "legend": "{{kind}}",
+                }
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            description=(
+                "fresh = a real deserialize failed (now negative-cached); "
+                "cached = the TTL'd negative cache answered"
+            ),
+        ),
+        _timeseries(
+            "Flight recorder",
+            [
+                {
+                    "expr": "sum(gordo_server_flight_traces) by (cls)",
+                    "legend": "held {{cls}}",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_flight_recorded_total[5m])) by (cls)",
+                    "legend": "kept/s {{cls}}",
+                },
+            ],
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+            description=(
+                "Tail-sampled request traces held in the /debug/flight "
+                "ring (error vs slow), and the keep rate — a rising error "
+                "keep rate is an incident before the alert fires"
+            ),
+        ),
+        _stat(
+            "Watchdog trips",
+            "sum(gordo_server_watchdog_trips_total)",
+            panel_id=6,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "Breakers open now",
+            "count(gordo_server_breaker_state == 2) or vector(0)",
+            panel_id=7,
+            x=_PANEL_W + 6,
+            y=2 * _PANEL_H,
+        ),
+    ]
+    return _dashboard(
+        "Gordo TPU serving resilience", "gordo-tpu-resilience", panels
+    )
+
+
 def write_dashboards(out_dir: str) -> List[str]:
     """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -518,6 +663,7 @@ def write_dashboards(out_dir: str) -> List[str]:
         ("gordo_tpu_servers.json", servers_dashboard),
         ("gordo_tpu_machines.json", machines_dashboard),
         ("gordo_tpu_build.json", build_dashboard),
+        ("gordo_tpu_resilience.json", resilience_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

@@ -174,6 +174,22 @@ FAST_CODEC_FALLBACK = telemetry.counter(
     "non-canonical response frames), by op",
     ("op",),
 )
+# ------------------------------------------------ flight recorder (PR 5)
+# wired by observability/flight.py; read back through /debug/flight
+FLIGHT_RECORDED = telemetry.counter(
+    "gordo_server_flight_recorded_total",
+    "Request traces kept by the flight recorder's tail sampling, by kept "
+    "class (error: any 4xx/5xx incl. shed/504/breaker; slow: wall time "
+    "over the GORDO_TPU_FLIGHT_SLOW_S or adaptive p99-ish threshold)",
+    ("cls",),
+)
+FLIGHT_OCCUPANCY = telemetry.gauge(
+    "gordo_server_flight_traces",
+    "Traces currently held in the flight recorder's ring buffer, by class "
+    "(each class has its own bounded ring, so errors are never evicted by "
+    "a flood of slow-but-successful requests)",
+    ("cls",),
+)
 MODEL_LOAD_FAILURES = telemetry.counter(
     "gordo_server_model_load_failures_total",
     "Model-load failures in the serving path, by kind: fresh (a real "

@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from gordo_tpu.observability import tracing as _request_tracing
+
 __all__ = [
     "MetricsRegistry",
     "Counter",
@@ -56,6 +58,7 @@ __all__ = [
     "gauge",
     "histogram",
     "span",
+    "add_trace_event",
     "spans_enabled",
     "enable_spans",
     "start_trace",
@@ -530,17 +533,29 @@ class _NullSpan:
     def __exit__(self, *exc) -> bool:
         return False
 
+    def set_attrs(self, **attrs) -> None:
+        """No-op twin of :meth:`_Span.set_attrs`."""
+
 
 _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "hist", "attrs", "_t0", "_annotation")
+    __slots__ = (
+        "name", "hist", "attrs", "links",
+        "_t0", "_annotation", "_ctx", "_span_id", "_token",
+    )
 
-    def __init__(self, name: str, hist: Optional[Histogram], attrs):
+    def __init__(self, name: str, hist: Optional[Histogram], attrs, links=()):
         self.name = name
         self.hist = hist
         self.attrs = attrs
+        self.links = tuple(links)
+
+    def set_attrs(self, **attrs) -> None:
+        """Add/overwrite span attributes mid-flight (e.g. the matched
+        route, known only after the span opened)."""
+        self.attrs.update(attrs)
 
     def __enter__(self):
         from gordo_tpu.util.profiling import annotate
@@ -549,33 +564,79 @@ class _Span:
         # timelines (GORDO_TPU_PROFILE_DIR) and telemetry spans line up
         self._annotation = annotate(self.name)
         self._annotation.__enter__()
+        # request-scoped tracing: under an active trace context this span
+        # becomes the ambient parent for anything opened inside it
+        self._ctx = _request_tracing.current()
+        self._token = None
+        if self._ctx is not None:
+            self._span_id = _request_tracing.new_span_id()
+            self._token = _request_tracing.push_child(self._ctx, self._span_id)
         self._t0 = time.monotonic()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         duration = time.monotonic() - self._t0
         self._annotation.__exit__(exc_type, exc, tb)
+        ctx = self._ctx
+        if self._token is not None:
+            _request_tracing.pop(self._token)
+        if ctx is not None:
+            if exc_type is not None:
+                self.attrs.setdefault("error", exc_type.__name__)
+            if ctx.collector is not None:
+                ctx.collector.add(
+                    _request_tracing.SpanRecord(
+                        self.name, ctx.trace_id, self._span_id,
+                        ctx.span_id, self._t0, duration,
+                        attrs=self.attrs, links=self.links,
+                    )
+                )
         trace = _trace
         if trace is not None:
-            trace.add(self.name, self._t0, duration, self.attrs)
+            attrs = self.attrs
+            if ctx is not None:
+                # trace/span ids in the Chrome-trace args: Perfetto's args
+                # filter then isolates one request/machine end to end
+                attrs = dict(attrs)
+                attrs["trace_id"] = ctx.trace_id
+                attrs["span_id"] = self._span_id
+            trace.add(self.name, self._t0, duration, attrs)
         if self.hist is not None:
             self.hist.observe(duration)
         return False
 
 
-def span(name: str, hist: Optional[Histogram] = None, **attrs):
+def span(name: str, hist: Optional[Histogram] = None, links=(), **attrs):
     """A named timing span.
 
     Active when a trace was started (:func:`start_trace`), span timing was
-    enabled (:func:`enable_spans`, the ``--metrics-file``-only mode), or
-    JAX profiling is on (``$GORDO_TPU_PROFILE_DIR``). Otherwise returns the
-    shared no-op singleton. ``hist``: a :class:`Histogram` to observe the
-    span's duration into on exit (phase-duration metrics without a second
-    timer at the call site).
+    enabled (:func:`enable_spans`, the ``--metrics-file``-only mode), a
+    request trace context is attached (:mod:`..tracing` — the span joins
+    the request's tree), or JAX profiling is on
+    (``$GORDO_TPU_PROFILE_DIR``). Otherwise returns the shared no-op
+    singleton. ``hist``: a :class:`Histogram` to observe the span's
+    duration into on exit (phase-duration metrics without a second timer
+    at the call site). ``links``: (trace_id, span_id) pairs of correlated
+    spans in other traces (the batcher's co-fused riders).
     """
-    if not _spans_enabled and not os.environ.get("GORDO_TPU_PROFILE_DIR"):
+    if (
+        not _spans_enabled
+        and _request_tracing.current() is None
+        and not os.environ.get("GORDO_TPU_PROFILE_DIR")
+    ):
         return _NULL_SPAN
-    return _Span(name, hist, attrs)
+    return _Span(name, hist, attrs, links)
+
+
+def add_trace_event(
+    name: str, start: float, duration: float, **attrs
+) -> None:
+    """Record one already-timed event into the active global trace buffer
+    (no-op without one). For work timed manually because its span records
+    are fanned out elsewhere — the batcher's fused device call."""
+    trace = _trace
+    if trace is not None:
+        trace.add(name, start, duration, attrs)
 
 
 def spans_enabled() -> bool:

@@ -68,7 +68,7 @@ from gordo_tpu.ops.train import (
     n_train_samples,
 )
 from gordo_tpu.observability import metrics as metric_catalog
-from gordo_tpu.observability import telemetry
+from gordo_tpu.observability import telemetry, tracing
 from gordo_tpu.util import faults
 from gordo_tpu.util.faults import FaultPolicy, QuarantineRecord
 from .mesh import default_mesh, machines_sharding
@@ -89,6 +89,20 @@ _PHASE_ASSEMBLE = metric_catalog.BUILD_PHASE_SECONDS.labels(phase="assemble")
 # trace+lower+compile+first chunk dispatch — jit compiles synchronously on
 # the first call, execution is dispatched async, so it is compile-dominated)
 _first_compile_walls: Dict[Tuple, float] = {}
+
+
+def _machine_trace(name: str):
+    """A fresh trace root per machine (memoized by name): every span one
+    machine emits — fetch, validate, assemble, serialize, across phases
+    and thread-pool lanes — shares one trace_id in the exported Chrome
+    trace, so Perfetto's args filter isolates a single machine out of a
+    fleet build. Null when spans are dormant: the disabled build path
+    must keep allocating nothing."""
+    import contextlib
+
+    if not telemetry.spans_enabled():
+        return contextlib.nullcontext()
+    return tracing.attach(tracing.root_for(name))
 
 
 def _machine_seed(machine: Machine) -> int:
@@ -604,7 +618,7 @@ class BatchedModelBuilder:
     # -------------------------------------------------------------- data
     def _load_data(self, plan: _Plan):
         t0 = time.time()
-        with telemetry.span(
+        with _machine_trace(plan.machine.name), telemetry.span(
             "fetch", _PHASE_FETCH, machine=plan.machine.name
         ):
             faults.fault_point("data_fetch", machine=plan.machine.name)
@@ -754,7 +768,7 @@ class BatchedModelBuilder:
         if model_dir is None:
             return
         os.makedirs(model_dir, exist_ok=True)
-        with telemetry.span(
+        with _machine_trace(machine_out.name), telemetry.span(
             "serialize", _PHASE_SERIALIZE, machine=machine_out.name
         ):
             serializer.dump(model, model_dir, metadata=machine_out.to_dict())
@@ -884,7 +898,7 @@ class BatchedModelBuilder:
         # would be garbage and, pre-bucketing, it is trivially isolable
         for i in list(plans):
             plan = plans[i]
-            with telemetry.span(
+            with _machine_trace(plan.machine.name), telemetry.span(
                 "validate", _PHASE_VALIDATE, machine=plan.machine.name
             ):
                 bad = faults.non_finite_report(plan.X, plan.y)
@@ -1287,7 +1301,7 @@ class BatchedModelBuilder:
         per_machine_est: float, kfold_folds=None,
     ) -> Tuple[Any, Machine]:
         n_stages = len(fold_bounds) + 1
-        with telemetry.span(
+        with _machine_trace(plan.machine.name), telemetry.span(
             "assemble", _PHASE_ASSEMBLE, machine=plan.machine.name
         ):
             built = self._assemble(

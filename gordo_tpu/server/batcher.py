@@ -69,6 +69,11 @@ class _Item:
     # set by the waiter when its timeout/deadline expires: the dispatcher
     # skips abandoned items at fan-out instead of computing for nobody
     abandoned: bool = False
+    # trace context captured at enqueue (inside the waiter's queue span):
+    # the dispatcher fans the fused device-call span into every rider's
+    # trace, parented here, with span-links to the co-fused riders — one
+    # slow fuse then explains N slow requests
+    trace_ctx: Any = None
 
 
 @functools.lru_cache(maxsize=256)
@@ -406,6 +411,7 @@ class CrossModelBatcher:
         against the budget. A waiter that gives up marks its item
         *abandoned*: the dispatcher skips it at fan-out instead of
         computing a result nobody is waiting for."""
+        from gordo_tpu.observability import telemetry, tracing
         from gordo_tpu.ops.train import pad_for_predict
         from gordo_tpu.server import resilience
 
@@ -422,20 +428,25 @@ class CrossModelBatcher:
             timeout = remaining
             deadline_bound = True
         self._ensure_thread()
-        self._q.put(item)
-        if not item.done.wait(timeout=timeout):
-            item.abandoned = True
-            self._record_abandoned(item)
-            if deadline_bound:
-                resilience.record_deadline_exceeded("queue_wait")
-                raise resilience.DeadlineExceeded(
-                    f"batched predict abandoned: request deadline "
-                    f"({timeout * 1e3:.0f}ms remaining at submit) expired "
-                    f"in the batch queue"
+        # the queue span covers enqueue → fan-out; the context captured
+        # INSIDE it is what the dispatcher parents the device-call span
+        # under, so the request's tree reads: request → queue → device call
+        with telemetry.span("serve_batch_queue", model=item.tag):
+            item.trace_ctx = tracing.capture()
+            self._q.put(item)
+            if not item.done.wait(timeout=timeout):
+                item.abandoned = True
+                self._record_abandoned(item)
+                if deadline_bound:
+                    resilience.record_deadline_exceeded("queue_wait")
+                    raise resilience.DeadlineExceeded(
+                        f"batched predict abandoned: request deadline "
+                        f"({timeout * 1e3:.0f}ms remaining at submit) "
+                        f"expired in the batch queue"
+                    )
+                raise TimeoutError(
+                    f"batched predict timed out after {timeout:.0f}s"
                 )
-            raise TimeoutError(
-                f"batched predict timed out after {timeout:.0f}s"
-            )
         if item.error is not None:
             raise item.error
         return item.result
@@ -597,7 +608,8 @@ class CrossModelBatcher:
         X, idx = self._stacked_inputs(items, slots, b_pad)
         # the busy window feeds the device watchdog: a wedged call here is
         # what flips /healthcheck to 503 (resilience.stuck_device_call_s)
-        self._busy_since = time.monotonic()
+        t0 = time.monotonic()
+        self._busy_since = t0
         try:
             faults.fault_point(
                 "serve_device_call", machines=[it.tag for it in items]
@@ -607,8 +619,14 @@ class CrossModelBatcher:
                     bank.stacked, idx, X
                 )
             )
+        except BaseException as exc:  # noqa: BLE001 — span then re-raise
+            self._emit_device_span(items, t0, error=exc)
+            raise
         finally:
             self._busy_since = None
+        # recorded BEFORE fan-out (done.set): a rider resuming at its
+        # event must already find the device-call span in its trace
+        self._emit_device_span(items, t0)
         self.stats["items"] += n
         self.stats["device_calls"] += 1
         self.stats["largest_batch"] = max(self.stats["largest_batch"], n)
@@ -627,6 +645,39 @@ class CrossModelBatcher:
                 item.result = result
             item.done.set()
 
+    def _emit_device_span(
+        self,
+        items: List[_Item],
+        t0: float,
+        error: Optional[BaseException] = None,
+        rescue: bool = False,
+    ) -> None:
+        """Record the finished device call as a span in EVERY rider's
+        trace (parented at that rider's enqueue point, span-links naming
+        the co-fused riders) plus one event in the global trace buffer.
+        Runs in the dispatcher thread, which never holds a request
+        context — hence explicit fan-out instead of telemetry.span."""
+        from gordo_tpu.observability import telemetry, tracing
+
+        duration = time.monotonic() - t0
+        attrs: Dict[str, Any] = {"fused": len(items)}
+        if rescue:
+            attrs["rescue"] = 1
+        if error is not None:
+            attrs["error"] = type(error).__name__
+        telemetry.add_trace_event("serve_device_call", t0, duration, **attrs)
+        riders = [it for it in items if it.trace_ctx is not None]
+        for item in riders:
+            links = [
+                (other.trace_ctx.trace_id, other.trace_ctx.span_id or "")
+                for other in riders
+                if other is not item
+            ]
+            tracing.record_into(
+                item.trace_ctx, "serve_device_call", t0, duration,
+                links=links, model=item.tag, **attrs,
+            )
+
     def _serial_rescue(self, spec, item: _Item, group_exc: BaseException):
         """Last ladder rung: retry one predict through the un-fused
         program. Its failure (or a matching injected fault) lands on this
@@ -638,7 +689,8 @@ class CrossModelBatcher:
             return
         metric_catalog.GROUP_SERIAL_RESCUES.inc()
         try:
-            self._busy_since = time.monotonic()
+            t0 = time.monotonic()
+            self._busy_since = t0
             try:
                 faults.fault_point("serve_device_call", machines=[item.tag])
                 out = np.asarray(
@@ -646,6 +698,7 @@ class CrossModelBatcher:
                 )
             finally:
                 self._busy_since = None
+                self._emit_device_span([item], t0, rescue=True)
             result = out[: item.n_keep]
             if resilience.validate_output_enabled() and not np.all(
                 np.isfinite(result)

@@ -1,0 +1,147 @@
+"""
+Read-only introspection endpoints: the operator's first stop on a pager.
+
+Three routes, all gated by ``GORDO_TPU_DEBUG_ENDPOINTS=1`` (without it
+they answer 404 exactly like unknown paths — a production server exposes
+nothing new by default):
+
+- ``GET /debug/flight`` — the flight recorder's kept request traces as
+  Chrome trace-event JSON (save the body to a file, open it in Perfetto
+  or ``chrome://tracing``; the ``gordoFlight`` sidecar lists per-trace
+  summaries for grepping). This is the per-incident forensics surface:
+  find the trace whose id a client quoted from its ``X-Gordo-Trace``
+  header, and read the request's whole span tree.
+- ``GET /debug/vars`` — a live snapshot of every telemetry metric series
+  plus batcher/in-flight process state, as JSON. Unlike ``/metrics`` it
+  needs no prometheus_client, no scrape pipeline, and returns structured
+  values (``curl | jq`` during an incident).
+- ``GET /debug/config`` — the resolved ``GORDO_TPU_*`` knob values this
+  process is actually running with (env-set knobs verbatim, effective
+  values for the serving knobs that have defaults). Values whose name
+  suggests a secret are redacted.
+
+Everything here is read-only: no handler mutates server state.
+"""
+
+import os
+from typing import Any, Dict
+
+try:
+    import simplejson
+except ImportError:  # pragma: no cover - environment-dependent
+    from gordo_tpu.util import _simplejson as simplejson
+
+from werkzeug.wrappers import Response
+
+from gordo_tpu.observability import flight, telemetry
+from gordo_tpu.server import resilience
+
+# substrings that mark a knob's VALUE as sensitive — never echo those
+# through an HTTP endpoint, even a gated one
+_SECRET_MARKERS = ("PASSWORD", "SECRET", "TOKEN", "KEY", "CREDENTIAL")
+
+
+def enabled() -> bool:
+    return os.environ.get("GORDO_TPU_DEBUG_ENDPOINTS", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+def _json(payload: Dict[str, Any], status: int = 200) -> Response:
+    return Response(
+        simplejson.dumps(payload, ignore_nan=True),
+        status=status,
+        mimetype="application/json",
+    )
+
+
+def dispatch(endpoint: str, config: Dict[str, Any]) -> Response:
+    """Route one ``debug_*`` endpoint; 404 when the gate is off."""
+    if not enabled():
+        # indistinguishable from an unknown route: the debug surface is
+        # invisible unless explicitly enabled
+        return Response("Not Found", status=404)
+    if endpoint == "debug_flight":
+        return flight_view()
+    if endpoint == "debug_vars":
+        return vars_view(config)
+    return config_view()
+
+
+# -------------------------------------------------------------- /debug/flight
+def flight_view() -> Response:
+    return _json(flight.default_recorder().chrome_trace())
+
+
+# ---------------------------------------------------------------- /debug/vars
+def vars_view(config: Dict[str, Any]) -> Response:
+    """Every telemetry series' current value, plus process serving state."""
+    metrics: Dict[str, Any] = {}
+    for metric in telemetry.default_registry().collect():
+        series = []
+        for key, value in metric.snapshot():
+            labels = dict(zip(metric.labelnames, key))
+            if metric.kind == "histogram":
+                counts, total = value
+                series.append(
+                    {"labels": labels, "count": sum(counts), "sum": total}
+                )
+            else:
+                series.append({"labels": labels, "value": value})
+        metrics[metric.name] = {"kind": metric.kind, "series": series}
+
+    from gordo_tpu.server.batcher import peek_batcher
+
+    batcher = peek_batcher()
+    recorder = flight.default_recorder()
+    return _json(
+        {
+            "metrics": metrics,
+            "server": {
+                "inflight_requests": resilience.inflight_requests(),
+                "gated_inflight": resilience.gated_inflight(),
+                "draining": resilience.is_draining(),
+                "project": config.get("PROJECT"),
+            },
+            "batcher": None if batcher is None else dict(batcher.stats),
+            "flight": {
+                "seen": recorder.seen,
+                "kept": recorder.kept,
+                "slow_threshold_s": recorder.slow_threshold_s(),
+            },
+        }
+    )
+
+
+# -------------------------------------------------------------- /debug/config
+def _redact(name: str, value: str) -> str:
+    if any(marker in name.upper() for marker in _SECRET_MARKERS):
+        return "<redacted>"
+    return value
+
+
+def config_view() -> Response:
+    """The knobs as this process resolved them: raw env for everything
+    GORDO_TPU_*-shaped that is set, plus the effective values of serving
+    knobs with live defaults (what the code would actually use NOW)."""
+    env = {
+        name: _redact(name, value)
+        for name, value in sorted(os.environ.items())
+        if name.startswith("GORDO_TPU_")
+    }
+    resolved = {
+        "max_inflight": resilience.max_inflight(),
+        "retry_after_s": resilience.retry_after_s(),
+        "deadline_ms_default": resilience.deadline_ms_from({}),
+        "breaker_threshold": resilience.breaker_threshold(),
+        "drain_budget_s": resilience.drain_budget_s(),
+        "watchdog_threshold_s": resilience.watchdog_threshold_s(),
+        "validate_output": resilience.validate_output_enabled(),
+        "flight_capacity": flight.capacity_from_env(),
+        "flight_slow_s": flight.default_recorder().slow_threshold_s(),
+        "debug_endpoints": enabled(),
+        "log_format": os.environ.get("GORDO_TPU_LOG_FORMAT", "plain"),
+        "serving_batch": os.environ.get("GORDO_TPU_SERVING_BATCH", "off"),
+        "fast_codec": os.environ.get("GORDO_TPU_FAST_CODEC", "1"),
+    }
+    return _json({"env": env, "resolved": resolved})

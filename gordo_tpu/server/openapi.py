@@ -217,6 +217,39 @@ def openapi_document() -> dict:
                 "get": {"summary": "Server version",
                         "responses": {"200": {"description": "{version}"}}}
             },
+            "/debug/flight": {
+                "get": {
+                    "summary": "Flight recorder: tail-sampled request "
+                    "traces as Chrome trace-event JSON (open in Perfetto); "
+                    "gated by GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "Chrome trace-event JSON "
+                                "with a gordoFlight summary sidecar"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
+            "/debug/vars": {
+                "get": {
+                    "summary": "Live telemetry-metric and serving-state "
+                    "snapshot as JSON; gated by GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "{metrics, server, batcher, "
+                                "flight}"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
+            "/debug/config": {
+                "get": {
+                    "summary": "Resolved GORDO_TPU_* knob values (secrets "
+                    "redacted); gated by GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "{env, resolved}"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
             "/metrics": {
                 "get": {"summary": "Prometheus metrics (when enabled)",
                         "responses": {"200": {"description": "text format"},

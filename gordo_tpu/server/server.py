@@ -31,6 +31,7 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from gordo_tpu import __version__
+from gordo_tpu.observability import flight, telemetry, tracing
 from gordo_tpu.server import resilience, views
 
 logger = logging.getLogger(__name__)
@@ -150,6 +151,11 @@ class GordoServer:
             Rule("/readiness", endpoint="readiness"),
             Rule("/server-version", endpoint="server_version"),
             Rule("/metrics", endpoint="metrics"),
+            # read-only introspection (server/debug.py), 404 unless
+            # GORDO_TPU_DEBUG_ENDPOINTS=1
+            Rule("/debug/flight", endpoint="debug_flight"),
+            Rule("/debug/vars", endpoint="debug_vars"),
+            Rule("/debug/config", endpoint="debug_config"),
             Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
             Rule(
                 "/gordo/v0/<gordo_project>/models",
@@ -312,6 +318,58 @@ class GordoServer:
 
     def dispatch_request(self, request: Request) -> Response:
         ctx = RequestContext(self.config)
+        # every request runs under a trace context: continue the caller's
+        # W3C traceparent when present, else mint a fresh trace. The root
+        # span and everything below it (decode/predict/encode phases, the
+        # batcher queue, the fused device call) attach to one tree the
+        # flight recorder can keep when the request turns out interesting.
+        with tracing.request_root(
+            request.headers.get("traceparent")
+        ) as rtrace:
+            with telemetry.span(
+                "serve_request", method=request.method
+            ) as root_span:
+                response = self._route_and_dispatch(
+                    ctx, request, root_span
+                )
+            # Server-Timing: the reference's single request_walltime_s
+            # entry (kept first, same name/unit, for client parity) plus a
+            # per-phase breakdown recorded by the views (decode/predict/
+            # encode — where a prediction request's time actually went).
+            # Seconds throughout, marked by the _s suffix (the reference
+            # already broke the spec's milliseconds convention; consistency
+            # wins over mixing units). Stamped on EVERY response — error
+            # classes included (4xx/5xx, shed 503, deadline 504): the
+            # failures are exactly the responses worth attributing.
+            runtime_s = timeit.default_timer() - ctx.start_time
+            entries = [f"request_walltime_s;dur={runtime_s}"]
+            entries.extend(
+                f"{name}_s;dur={duration}"
+                for name, duration in ctx.timings.items()
+            )
+            response.headers["Server-Timing"] = ", ".join(entries)
+            if ctx.revision:
+                response.headers["revision"] = ctx.revision
+            # the trace id echoed back: a caller quoting this header names
+            # the exact trace in /debug/flight and the JSON logs
+            response.headers["X-Gordo-Trace"] = rtrace.trace_id
+            logger.debug(
+                "request %s %s -> %d in %.4fs",
+                request.method, request.path, response.status_code,
+                runtime_s,
+            )
+        flight.default_recorder().observe(
+            rtrace.collector,
+            status=response.status_code,
+            duration_s=runtime_s,
+            endpoint=request.environ.get("gordo_tpu.rule", request.path),
+            model=request.environ.get("gordo_tpu.model", ""),
+        )
+        return response
+
+    def _route_and_dispatch(
+        self, ctx: RequestContext, request: Request, root_span
+    ) -> Response:
         adapter = self.url_map.bind_to_environ(request.environ)
         try:
             rule, values = adapter.match(return_rule=True)
@@ -320,6 +378,7 @@ class GordoServer:
             # path: raw paths are unbounded label cardinality (any bot
             # scanning random URLs would mint a new timeseries per hit)
             request.environ["gordo_tpu.rule"] = rule.rule
+            root_span.set_attrs(endpoint=endpoint, rule=rule.rule)
         except MethodNotAllowed as exc:
             # the PATH matched a real route (wrong method): keep endpoint
             # attribution in the metrics instead of lumping the 405 into
@@ -335,6 +394,9 @@ class GordoServer:
             return exc.get_response()
         except HTTPException as exc:
             return exc.get_response()
+        if values.get("gordo_name"):
+            request.environ["gordo_tpu.model"] = values["gordo_name"]
+            root_span.set_attrs(model=values["gordo_name"])
 
         # ----------------------------------------------- serving resilience
         # (every knob defaults off: with none set, this block admits every
@@ -353,29 +415,13 @@ class GordoServer:
                 scope.__enter__()
 
         try:
-            response = self._dispatch_endpoint(
+            return self._dispatch_endpoint(
                 ctx, request, endpoint, values, shed
             )
         finally:
             if admitted:
                 scope.__exit__(None, None, None)
                 resilience.release()
-
-        # Server-Timing: the reference's single request_walltime_s entry
-        # (kept first, same name/unit, for client parity) plus a per-phase
-        # breakdown recorded by the views (decode/predict/encode — where a
-        # prediction request's time actually went). Seconds throughout,
-        # marked by the _s suffix (the reference already broke the spec's
-        # milliseconds convention; consistency wins over mixing units).
-        runtime_s = timeit.default_timer() - ctx.start_time
-        entries = [f"request_walltime_s;dur={runtime_s}"]
-        entries.extend(
-            f"{name}_s;dur={duration}" for name, duration in ctx.timings.items()
-        )
-        response.headers["Server-Timing"] = ", ".join(entries)
-        if ctx.revision:
-            response.headers["revision"] = ctx.revision
-        return response
 
     def _dispatch_endpoint(
         self, ctx: RequestContext, request: Request, endpoint, values, shed
@@ -426,6 +472,10 @@ class GordoServer:
                         simplejson.dumps(openapi_document()),
                         mimetype="application/json",
                     )
+                elif endpoint.startswith("debug_"):
+                    from gordo_tpu.server import debug
+
+                    response = debug.dispatch(endpoint, self.config)
                 elif endpoint == "metrics":
                     if self._prometheus is None:
                         response = Response("metrics disabled", status=404)

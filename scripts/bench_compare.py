@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""
+Diff two bench records (``BENCH_r*.json``) and gate on regression.
+
+The repo accumulates one bench record per round (r01..r05 so far); until
+now the trajectory was eyeball-only. This script turns any pair into a
+checkable gate: ``python scripts/bench_compare.py BENCH_r04.json
+BENCH_r05.json`` exits non-zero when a headline metric regressed past
+the threshold, so CI (or a release script) can refuse a round that got
+slower.
+
+Compared metrics, read from each record's ``parsed`` block (the final
+summary line bench.py always emits, budget trips included):
+
+- ``value`` — headline machines/min trained (higher is better)
+- ``server_samples_per_sec`` — serving throughput (higher is better)
+- ``server_p50_net_of_floor_ms`` — serving p50 net of the device
+  round-trip floor (lower is better)
+
+Missing metrics are skipped with a note (old records predate some
+fields). Records from different platforms (cpu vs tpu) are not
+comparable — the script says so and exits 0 unless ``--strict-platform``
+makes that an error: a CI runner falling back to CPU must not read as a
+10x regression.
+
+Exit codes: 0 = no regression (or not comparable), 1 = regression past
+``--threshold`` (default 0.15 = 15%), 2 = a record is unusable (missing
+/ unparseable / no ``parsed`` block). Wired into tier-1 by
+tests/gordo_tpu/test_benchmarks.py.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+# (key, higher_is_better)
+METRICS: Tuple[Tuple[str, bool], ...] = (
+    ("value", True),
+    ("server_samples_per_sec", True),
+    ("server_p50_net_of_floor_ms", False),
+)
+
+
+def load_parsed(path: str) -> Optional[dict]:
+    """The record's ``parsed`` summary, or None when unusable."""
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"unusable record {path}: {exc}", file=sys.stderr)
+        return None
+    parsed = record.get("parsed")
+    if not isinstance(parsed, dict) or "value" not in parsed:
+        print(
+            f"unusable record {path}: no 'parsed' summary block "
+            f"(did the bench run emit its final line?)",
+            file=sys.stderr,
+        )
+        return None
+    return parsed
+
+
+def compare(
+    old: dict, new: dict, threshold: float
+) -> Tuple[List[str], List[str]]:
+    """(regressions, report_lines) between two parsed summaries."""
+    regressions: List[str] = []
+    lines: List[str] = []
+    for key, higher_better in METRICS:
+        old_value, new_value = old.get(key), new.get(key)
+        if not isinstance(old_value, (int, float)) or not isinstance(
+            new_value, (int, float)
+        ):
+            lines.append(f"{key}: skipped (absent in one record)")
+            continue
+        if old_value == 0:
+            lines.append(f"{key}: skipped (old value is 0)")
+            continue
+        # delta > 0 always means "got better"
+        delta = (new_value - old_value) / abs(old_value)
+        if not higher_better:
+            delta = -delta
+        verdict = "ok"
+        if delta < -threshold:
+            verdict = "REGRESSION"
+            regressions.append(
+                f"{key}: {old_value:g} -> {new_value:g} "
+                f"({delta * 100:+.1f}% vs threshold -{threshold * 100:.0f}%)"
+            )
+        lines.append(
+            f"{key}: {old_value:g} -> {new_value:g} "
+            f"({delta * 100:+.1f}%) {verdict}"
+        )
+    return regressions, lines
+
+
+def main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("old", help="baseline BENCH_r*.json")
+    parser.add_argument("new", help="candidate BENCH_r*.json")
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.15,
+        help="relative regression beyond which the gate fails "
+        "(default 0.15 = 15%%)",
+    )
+    parser.add_argument(
+        "--strict-platform",
+        action="store_true",
+        help="treat a platform mismatch (cpu vs tpu) as an error instead "
+        "of 'not comparable, exit 0'",
+    )
+    args = parser.parse_args(argv)
+
+    old = load_parsed(args.old)
+    new = load_parsed(args.new)
+    if old is None or new is None:
+        return 2
+
+    old_platform = old.get("platform") or "?"
+    new_platform = new.get("platform") or "?"
+    if old_platform != new_platform:
+        print(
+            f"not comparable: platforms differ "
+            f"({old_platform} vs {new_platform}) — a CPU-fallback run "
+            f"must not read as a regression"
+        )
+        return 2 if args.strict_platform else 0
+
+    regressions, lines = compare(old, new, args.threshold)
+    print(f"comparing {args.old} -> {args.new} (platform {new_platform})")
+    for line in lines:
+        print(f"  {line}")
+    if regressions:
+        print(f"{len(regressions)} regression(s) past threshold:")
+        for line in regressions:
+            print(f"  {line}")
+        return 1
+    print("no regression past threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

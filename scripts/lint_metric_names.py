@@ -1,27 +1,46 @@
 #!/usr/bin/env python
 """
-Lint: every metric registered under gordo_tpu/ must carry a ``gordo_``
-prefix and non-empty help text.
+Lint: metric registrations under gordo_tpu/ must be well-formed AND the
+catalog must be discoverable.
 
-Prometheus metric names are a public, append-only API: dashboards
-(observability/grafana.py), alert rules, and recording rules key on them.
-An unprefixed name collides with other exporters on the same host, and an
-empty help string makes /metrics and textfile exports undocumented at
-exactly the place operators read them. Same enforcement pattern as the
-PR 1 bare-except lint (scripts/lint_bare_except.py).
+Three checks:
+
+1. **Name + help** — every metric registered under the source roots must
+   carry a ``gordo_`` prefix and non-empty help text. Prometheus metric
+   names are a public, append-only API: dashboards
+   (observability/grafana.py), alert rules, and recording rules key on
+   them. An unprefixed name collides with other exporters on the same
+   host, and an empty help string makes /metrics and textfile exports
+   undocumented at exactly the place operators read them.
+2. **Bounded label cardinality** — label names that imply one series per
+   request/trace (``trace_id``, ``span_id``, ``request_id``, ...) are
+   rejected. A raw model name is a fine label (the fleet is bounded); a
+   raw trace id is a timeseries-per-request cardinality bomb that will
+   OOM the scrape pipeline. Trace ids belong in logs, span attrs, and
+   the flight recorder — never in metric labels.
+3. **Catalog coverage** (``--catalog``) — every metric defined in the
+   catalog module (observability/metrics.py) must appear in at least one
+   doc page or generated dashboard. A metric nothing documents or plots
+   is invisible at exactly the moment an operator needs it — the same
+   rule lint_env_knobs.py enforces for env knobs.
 
 Checked call shapes: any call to ``Counter``/``Gauge``/``Histogram``
-(prometheus_client or telemetry classes) or the telemetry factory functions
-``counter``/``gauge``/``histogram`` whose metric name is a string literal.
-Calls whose name argument is a variable (the telemetry registry's own
-internals) are skipped — the registry validates help text at runtime.
+(prometheus_client or telemetry classes) or the telemetry factory
+functions ``counter``/``gauge``/``histogram`` whose metric name is a
+string literal. Calls whose name argument is a variable (the telemetry
+registry's own internals) are skipped — the registry validates help text
+at runtime.
 
-Usage: ``python scripts/lint_metric_names.py [root ...]`` (default:
-``gordo_tpu``). Exit 0 = clean, 1 = violations (printed one per line),
-2 = a file failed to parse. Wired into tier-1 via
-tests/gordo_tpu/test_lint.py.
+Usage: ``python scripts/lint_metric_names.py [root ...]
+[--catalog PATH --refs PATH ...]`` (default roots: ``gordo_tpu``; with
+default roots the catalog check runs against
+``gordo_tpu/observability/metrics.py`` vs ``docs`` +
+``gordo_tpu/observability/grafana.py`` + ``README.md``). Exit 0 = clean,
+1 = violations (printed one per line), 2 = a file failed to parse.
+Wired into tier-1 via tests/gordo_tpu/test_lint.py.
 """
 
+import argparse
 import ast
 import pathlib
 import sys
@@ -31,6 +50,21 @@ _FACTORY_NAMES = {
     "Counter", "Gauge", "Histogram", "Summary",
     "counter", "gauge", "histogram",
 }
+
+# label names whose values are unbounded by construction: one series per
+# request/trace/span. Bounded identity labels (model/machine names: the
+# fleet is finite) are fine; per-request identity is not.
+_UNBOUNDED_LABELS = {
+    "trace_id", "span_id", "parent_span_id", "request_id",
+    "correlation_id", "trace", "span", "uuid", "url",
+}
+
+_DEFAULT_CATALOG = "gordo_tpu/observability/metrics.py"
+_DEFAULT_REFS = (
+    "docs",
+    "gordo_tpu/observability/grafana.py",
+    "README.md",
+)
 
 
 def _call_name(node: ast.Call) -> Optional[str]:
@@ -59,20 +93,38 @@ def _argument(node: ast.Call, position: int, *keywords: str):
     return None
 
 
+def _label_literals(node) -> List[str]:
+    """String elements of a list/tuple literal labelnames argument
+    (non-literal labels are unlintable and skipped)."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return []
+    out = []
+    for element in node.elts:
+        label = _string_literal(element)
+        if label is not None:
+            out.append(label)
+    return out
+
+
+def _metric_calls(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) not in _FACTORY_NAMES:
+            continue
+        name = _string_literal(_argument(node, 0, "name"))
+        if name is None:
+            # name is a variable/expression (e.g. the registry's own
+            # get-or-create plumbing): nothing checkable here
+            continue
+        yield node, name
+
+
 def find_bad_metrics(root: str) -> List[str]:
     violations = []
     for path in sorted(pathlib.Path(root).rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if _call_name(node) not in _FACTORY_NAMES:
-                continue
-            name = _string_literal(_argument(node, 0, "name"))
-            if name is None:
-                # name is a variable/expression (e.g. the registry's own
-                # get-or-create plumbing): nothing checkable here
-                continue
+        for node, name in _metric_calls(path):
             where = f"{path}:{node.lineno}"
             if not name.startswith("gordo_"):
                 violations.append(
@@ -89,18 +141,77 @@ def find_bad_metrics(root: str) -> List[str]:
                     f"text (/metrics and textfile exports are the operator "
                     f"docs)"
                 )
+            labels_node = _argument(node, 2, "labelnames", "labels")
+            for label in _label_literals(labels_node):
+                if label.lower() in _UNBOUNDED_LABELS:
+                    violations.append(
+                        f"{where}: metric {name!r} label {label!r} is "
+                        f"unbounded cardinality (one timeseries per "
+                        f"request/trace would OOM the scrape pipeline; "
+                        f"put per-request ids in span attrs and logs, "
+                        f"not metric labels)"
+                    )
+    return violations
+
+
+def find_unreferenced(catalog: str, refs: List[str]) -> List[str]:
+    """Catalog metrics that no doc page or dashboard source mentions."""
+    corpus = []
+    for ref in refs:
+        ref_path = pathlib.Path(ref)
+        if ref_path.is_file():
+            corpus.append(ref_path.read_text(errors="replace"))
+        elif ref_path.is_dir():
+            for path in sorted(ref_path.rglob("*.md")):
+                corpus.append(path.read_text(errors="replace"))
+            for path in sorted(ref_path.rglob("*.json")):
+                corpus.append(path.read_text(errors="replace"))
+    text = "\n".join(corpus)
+    violations = []
+    catalog_path = pathlib.Path(catalog)
+    for node, name in _metric_calls(catalog_path):
+        if name not in text:
+            violations.append(
+                f"{catalog_path}:{node.lineno}: metric {name!r} appears in "
+                f"no doc or dashboard under {', '.join(refs)} — an "
+                f"unplotted, undocumented metric is invisible to operators"
+            )
     return violations
 
 
 def main(argv: List[str]) -> int:
-    roots = argv or ["gordo_tpu"]
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("roots", nargs="*", default=[])
+    parser.add_argument(
+        "--catalog",
+        default=None,
+        help="metric-catalog module to check for doc/dashboard coverage",
+    )
+    parser.add_argument(
+        "--refs",
+        nargs="*",
+        default=None,
+        help="doc/dashboard roots the catalog metrics must appear in",
+    )
+    args = parser.parse_args(argv)
+    roots = args.roots or ["gordo_tpu"]
+    catalog = args.catalog
+    refs = args.refs
+    if catalog is None and not args.roots:
+        # default invocation lints the real tree: catalog coverage included
+        catalog = _DEFAULT_CATALOG
+    if catalog is not None and refs is None:
+        refs = list(_DEFAULT_REFS)
+
     violations = []
-    for root in roots:
-        try:
+    try:
+        for root in roots:
             violations.extend(find_bad_metrics(root))
-        except SyntaxError as exc:
-            print(f"parse error: {exc}", file=sys.stderr)
-            return 2
+        if catalog is not None:
+            violations.extend(find_unreferenced(catalog, refs))
+    except SyntaxError as exc:
+        print(f"parse error: {exc}", file=sys.stderr)
+        return 2
     for line in violations:
         print(line)
     return 1 if violations else 0

@@ -237,3 +237,88 @@ def test_bench_section_crash_partial_recovery(monkeypatch):
     entry = bench._run_section("windowed", timeout=7)
     assert entry["partial"] and entry["result"] == {"fam_a": {"x": 1}}
     assert "error" in entry
+
+
+# ------------------------------------------------------- bench_compare gate
+def _run_compare(*args):
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "scripts",
+        "bench_compare.py",
+    )
+    return subprocess.run(
+        [sys.executable, script, *map(str, args)],
+        capture_output=True,
+        text=True,
+    )
+
+
+def _record(tmp_path, name, **parsed):
+    path = tmp_path / name
+    base = {"platform": "cpu"}
+    base.update(parsed)
+    path.write_text(json.dumps({"n": 1, "parsed": base}))
+    return path
+
+
+def test_bench_compare_no_regression(tmp_path):
+    old = _record(tmp_path, "old.json", value=100.0,
+                  server_samples_per_sec=1000.0,
+                  server_p50_net_of_floor_ms=10.0)
+    new = _record(tmp_path, "new.json", value=110.0,
+                  server_samples_per_sec=1200.0,
+                  server_p50_net_of_floor_ms=8.0)
+    result = _run_compare(old, new)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "no regression" in result.stdout
+
+
+def test_bench_compare_flags_regression_past_threshold(tmp_path):
+    old = _record(tmp_path, "old.json", value=100.0,
+                  server_p50_net_of_floor_ms=10.0)
+    # 30% slower headline, 2x worse serving p50: both past the 15% default
+    new = _record(tmp_path, "new.json", value=70.0,
+                  server_p50_net_of_floor_ms=20.0)
+    result = _run_compare(old, new)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "REGRESSION" in result.stdout
+    assert "server_p50_net_of_floor_ms" in result.stdout
+    # a wide-open threshold accepts the same pair (worst delta is the
+    # doubled p50 = -100%)
+    assert _run_compare(old, new, "--threshold", "1.5").returncode == 0
+
+
+def test_bench_compare_platform_mismatch_not_a_regression(tmp_path):
+    old = _record(tmp_path, "old.json", value=100.0, platform="tpu")
+    new = _record(tmp_path, "new.json", value=10.0, platform="cpu")
+    result = _run_compare(old, new)
+    assert result.returncode == 0
+    assert "not comparable" in result.stdout
+    assert _run_compare(old, new, "--strict-platform").returncode == 2
+
+
+def test_bench_compare_unusable_record(tmp_path):
+    old = _record(tmp_path, "old.json", value=100.0)
+    junk = tmp_path / "junk.json"
+    junk.write_text("{}")  # no parsed block
+    assert _run_compare(old, junk).returncode == 2
+    assert _run_compare(tmp_path / "missing.json", old).returncode == 2
+
+
+def test_bench_compare_smoke_on_checked_in_records():
+    """The r01–r05 trajectory is at least parseable by the gate: the
+    script must classify every checked-in record pair without crashing
+    (older records may legitimately be unusable/not-comparable)."""
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    records = sorted(
+        os.path.join(repo, f)
+        for f in os.listdir(repo)
+        if f.startswith("BENCH_r") and f.endswith(".json")
+    )
+    assert records, "no BENCH_r*.json records checked in"
+    result = _run_compare(records[0], records[-1])
+    assert result.returncode in (0, 1, 2), result.stderr

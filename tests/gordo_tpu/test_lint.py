@@ -166,3 +166,62 @@ def test_knob_lint_accepts_fully_documented_tree(tmp_path):
     (docs / "page.md").write_text("`GORDO_TPU_FINE_KNOB` turns it on\n")
     result = _run_knob_lint(src, docs)
     assert result.returncode == 0, result.stdout
+
+
+def test_metric_lint_flags_unbounded_label_cardinality(tmp_path):
+    bad = tmp_path / "offender.py"
+    bad.write_text(
+        "from gordo_tpu.observability import telemetry\n"
+        '# a bounded identity label (model names) is fine\n'
+        'ok = telemetry.counter(\n'
+        '    "gordo_fine_total", "per-model events", ("model",)\n'
+        ")\n"
+        '# per-request identity is a cardinality bomb\n'
+        'bad = telemetry.counter(\n'
+        '    "gordo_bomb_total", "per-trace events", ("trace_id",)\n'
+        ")\n"
+    )
+    result = _run_metric_lint(tmp_path)
+    assert result.returncode == 1
+    assert "trace_id" in result.stdout and "unbounded" in result.stdout
+    assert "gordo_fine_total" not in result.stdout
+
+
+def test_metric_lint_catalog_coverage(tmp_path):
+    """--catalog: every catalog metric must appear in a doc or dashboard."""
+    catalog = tmp_path / "metrics.py"
+    catalog.write_text(
+        "from gordo_tpu.observability import telemetry\n"
+        'a = telemetry.counter("gordo_plotted_total", "shown somewhere")\n'
+        'b = telemetry.counter("gordo_orphan_total", "shown nowhere")\n'
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "page.md").write_text("`gordo_plotted_total` counts things\n")
+    result = subprocess.run(
+        [
+            sys.executable, str(METRIC_LINT), str(tmp_path),
+            "--catalog", str(catalog), "--refs", str(docs),
+        ],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 1
+    assert "gordo_orphan_total" in result.stdout
+    assert "gordo_plotted_total" not in result.stdout
+
+
+def test_metric_lint_default_invocation_checks_real_catalog():
+    """The bare invocation (what tier-1 runs) includes catalog coverage
+    of observability/metrics.py against docs + dashboards."""
+    result = subprocess.run(
+        [sys.executable, str(METRIC_LINT)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, (
+        f"metric catalog drifted from docs/dashboards:\n"
+        f"{result.stdout}{result.stderr}"
+    )

@@ -6,6 +6,7 @@ import re
 from gordo_tpu.observability import (
     build_dashboard,
     machines_dashboard,
+    resilience_dashboard,
     servers_dashboard,
     telemetry,
     write_dashboards,
@@ -13,7 +14,12 @@ from gordo_tpu.observability import (
 from gordo_tpu.observability import metrics as metric_catalog  # noqa: F401
 from gordo_tpu.server.prometheus import metrics as server_metrics
 
-_ALL_DASHBOARDS = (servers_dashboard, machines_dashboard, build_dashboard)
+_ALL_DASHBOARDS = (
+    servers_dashboard,
+    machines_dashboard,
+    build_dashboard,
+    resilience_dashboard,
+)
 
 
 def _all_exprs(dash):
@@ -83,7 +89,7 @@ def test_latency_panels_use_quantiles_not_averages():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 3
+    assert len(paths) == 4
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
@@ -102,6 +108,7 @@ def test_checked_in_dashboards_are_current():
         ("gordo_tpu_servers.json", servers_dashboard),
         ("gordo_tpu_machines.json", machines_dashboard),
         ("gordo_tpu_build.json", build_dashboard),
+        ("gordo_tpu_resilience.json", resilience_dashboard),
     ):
         with open(os.path.join(out_dir, name)) as fh:
             assert json.load(fh) == build(), f"{name} is stale — regenerate with " \

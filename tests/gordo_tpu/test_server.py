@@ -702,3 +702,140 @@ def test_proxy_prefix_boundary_not_false_match():
     wrapped(environ, lambda *a: None)
     assert seen["PATH_INFO"] == "/metadata"
     assert seen["SCRIPT_NAME"] == "/svc"
+
+
+# ----------------------------------------- tracing headers on error classes
+# Server-Timing and X-Gordo-Trace must ride EVERY response — the failures
+# (4xx/5xx, shed 503, deadline 504, breaker fast-fail) are exactly the
+# responses worth attributing to a trace (ISSUE 5 satellite).
+def _assert_trace_headers(resp):
+    entries = _assert_server_timing(resp, phased=False)
+    assert "request_walltime_s" in entries
+    trace_id = resp.headers.get("X-Gordo-Trace")
+    assert trace_id and len(trace_id) == 32, resp.headers
+    return trace_id
+
+
+def test_error_headers_400_missing_X(client, gordo_project, gordo_name):
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", json={"noX": 1}
+    )
+    assert resp.status_code == 400
+    _assert_trace_headers(resp)
+
+
+def test_error_headers_404_unknown_model(client, gordo_project):
+    resp = client.post(
+        f"/gordo/v0/{gordo_project}/no-such-model/prediction", json={}
+    )
+    assert resp.status_code == 404
+    _assert_trace_headers(resp)
+
+
+def test_error_headers_405_wrong_method(client, gordo_project, gordo_name):
+    resp = client.get(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    )
+    assert resp.status_code == 405
+    _assert_trace_headers(resp)
+
+
+def test_error_headers_410_missing_revision(client, gordo_project, gordo_name):
+    resp = client.get(
+        f"/gordo/v0/{gordo_project}/{gordo_name}/metadata?revision=999"
+    )
+    assert resp.status_code == 410
+    _assert_trace_headers(resp)
+
+
+def test_error_headers_shed_503(client, gordo_project, gordo_name, monkeypatch):
+    from gordo_tpu.server import resilience
+
+    monkeypatch.setenv("GORDO_TPU_MAX_INFLIGHT", "1")
+    # occupy the only slot so the next prediction POST is shed
+    assert resilience.try_admit() is None
+    try:
+        resp = client.post(
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", json={}
+        )
+        assert resp.status_code == 503
+        assert resp.headers.get("Retry-After")
+        _assert_trace_headers(resp)
+    finally:
+        resilience.release()
+
+
+def test_error_headers_breaker_503(
+    client, gordo_project, gordo_name, monkeypatch
+):
+    from gordo_tpu.server import resilience
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "60")
+    try:
+        breaker = resilience.breaker_for(gordo_name)
+        breaker.record_failure(faults.PermanentFault("poisoned artifact"))
+        resp = client.post(
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", json={}
+        )
+        assert resp.status_code == 503
+        assert gordo_name in resp.get_json()["error"]
+        assert resp.headers.get("Retry-After")
+        _assert_trace_headers(resp)
+    finally:
+        resilience.reset_breakers()
+
+
+def test_error_headers_deadline_504(
+    client, gordo_project, gordo_name, X_payload, monkeypatch
+):
+    import json as _json
+
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        _json.dumps(
+            {
+                "rules": [
+                    {
+                        "site": "serve_predict",
+                        "times": 1,
+                        "error": "wedge",
+                        "seconds": 0.4,
+                    }
+                ]
+            }
+        ),
+    )
+    faults.reset_plan()
+    try:
+        resp = client.post(
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+            json={"X": dataframe_to_dict(X_payload)},
+            headers={"X-Gordo-Deadline-Ms": "100"},
+        )
+        assert resp.status_code == 504, resp.get_data(as_text=True)
+        _assert_trace_headers(resp)
+    finally:
+        monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+        faults.reset_plan()
+
+
+def test_traceparent_continued_and_echoed(client):
+    trace_id = "ab" * 16
+    resp = client.get(
+        "/healthcheck",
+        headers={"traceparent": f"00-{trace_id}-{'cd' * 8}-01"},
+    )
+    assert resp.headers["X-Gordo-Trace"] == trace_id
+    # malformed traceparent: fresh trace, request unaffected
+    resp = client.get("/healthcheck", headers={"traceparent": "garbage"})
+    assert resp.status_code == 200
+    assert len(resp.headers["X-Gordo-Trace"]) == 32
+
+
+def test_debug_endpoints_404_without_knob(client):
+    for path in ("/debug/flight", "/debug/vars", "/debug/config"):
+        assert client.get(path).status_code == 404, path

@@ -1,0 +1,477 @@
+"""
+Request tracing, the flight recorder, and the /debug endpoints (ISSUE 5).
+
+The headline test is the deterministic end-to-end: a fault-plan wedge on
+the fused device call + concurrent clients, then /debug/flight must hold
+the wedged requests' full span trees — root request span, batcher queue
+span, device-call span with span-links to the co-fused riders — with the
+same trace_id in the JSON log capture and the X-Gordo-Trace response
+header.
+"""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from gordo_tpu.observability import flight, logs, telemetry, tracing
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.observability.tracing import RequestTrace, SpanRecord
+from gordo_tpu.server import resilience
+from gordo_tpu.util import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset_plan()
+    resilience.reset_for_tests()
+    flight.reset()
+    telemetry.reset()
+    yield
+    faults.reset_plan()
+    resilience.reset_for_tests()
+    flight.reset()
+    telemetry.reset()
+
+
+# ----------------------------------------------------------- trace context
+def test_traceparent_roundtrip():
+    ctx = tracing.fresh_context()
+    header = tracing.format_traceparent(ctx)
+    parsed = tracing.parse_traceparent(header)
+    assert parsed == (ctx.trace_id, ctx.span_id)
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-zz" + "0" * 30 + "-" + "1" * 16 + "-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "00-" + "a" * 31 + "-" + "1" * 16 + "-01",  # short trace id
+    ],
+)
+def test_malformed_traceparent_rejected(header):
+    assert tracing.parse_traceparent(header) is None
+
+
+def test_span_tree_parents_follow_context():
+    with tracing.request_root(None) as root:
+        with telemetry.span("serve_request") as outer:
+            with telemetry.span("serve_decode"):
+                pass
+            with telemetry.span("serve_predict"):
+                with telemetry.span("serve_batch_queue"):
+                    pass
+    spans = {s.name: s for s in root.collector.snapshot()}
+    assert set(spans) == {
+        "serve_request", "serve_decode", "serve_predict", "serve_batch_queue",
+    }
+    req = spans["serve_request"]
+    assert req.parent_id is None
+    assert spans["serve_decode"].parent_id == req.span_id
+    assert spans["serve_predict"].parent_id == req.span_id
+    assert (
+        spans["serve_batch_queue"].parent_id == spans["serve_predict"].span_id
+    )
+    assert all(s.trace_id == root.trace_id for s in spans.values())
+    assert outer is not None  # real span, not the disabled singleton
+
+
+def test_inbound_traceparent_sets_root_parent():
+    remote_trace, remote_span = "ab" * 16, "cd" * 8
+    with tracing.request_root(f"00-{remote_trace}-{remote_span}-01") as root:
+        with telemetry.span("serve_request"):
+            pass
+    (req,) = root.collector.snapshot()
+    assert root.trace_id == remote_trace
+    assert req.trace_id == remote_trace
+    assert req.parent_id == remote_span
+
+
+def test_span_disabled_path_still_singleton():
+    # outside any request context the hot path stays allocation-free
+    assert telemetry.span("a") is telemetry.span("b")
+
+
+def test_capture_attach_across_threads():
+    captured = {}
+
+    with tracing.request_root(None) as root:
+        with telemetry.span("serve_batch_queue"):
+            ctx = tracing.capture()
+
+    def dispatcher():
+        tracing.record_into(
+            ctx, "serve_device_call", tracing.monotonic(), 0.01,
+            links=[("ff" * 16, "ee" * 8)], fused=2,
+        )
+        captured["done"] = True
+
+    t = threading.Thread(target=dispatcher)
+    t.start()
+    t.join()
+    assert captured["done"]
+    spans = {s.name: s for s in root.collector.snapshot()}
+    call = spans["serve_device_call"]
+    assert call.parent_id == spans["serve_batch_queue"].span_id
+    assert call.links == (("ff" * 16, "ee" * 8),)
+
+
+def test_request_trace_bounded():
+    trace = RequestTrace("ab" * 16)
+    for i in range(RequestTrace.MAX_SPANS + 10):
+        trace.add(
+            SpanRecord(f"s{i}", trace.trace_id, f"{i:016x}", None, 0.0, 0.0)
+        )
+    assert len(trace) == RequestTrace.MAX_SPANS
+    assert trace.dropped == 10
+
+
+def test_machine_roots_memoized():
+    a1, a2, b = (
+        tracing.root_for("machine-a"),
+        tracing.root_for("machine-a"),
+        tracing.root_for("machine-b"),
+    )
+    assert a1.trace_id == a2.trace_id
+    assert a1.trace_id != b.trace_id
+    tracing.reset_roots()
+    assert tracing.root_for("machine-a").trace_id != a1.trace_id
+
+
+# ---------------------------------------------------------- flight recorder
+def test_flight_classification(monkeypatch):
+    recorder = flight.FlightRecorder(capacity=8)
+    # cold adaptive threshold: nothing successful is "slow" yet
+    assert recorder.classify(200, 10.0) is None
+    assert recorder.classify(503, 0.001) == "error"
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.5")
+    assert recorder.classify(200, 0.6) == "slow"
+    assert recorder.classify(200, 0.4) is None
+
+
+def test_flight_adaptive_threshold_learns_p99():
+    recorder = flight.FlightRecorder(capacity=8)
+    for _ in range(200):
+        recorder.observe(None, status=200, duration_s=0.01)
+    threshold = recorder.slow_threshold_s()
+    # ~p99 of the 10ms population, floored at the adaptive minimum
+    assert threshold == pytest.approx(flight._ADAPTIVE_FLOOR_S)
+    assert recorder.classify(200, flight._ADAPTIVE_FLOOR_S + 0.01) == "slow"
+
+
+def test_flight_errors_survive_slow_flood(monkeypatch):
+    """Tail-sampling keeps errored traces over fast/slow ones: a flood of
+    slow-but-successful requests must never evict the error exemplars."""
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.1")
+    recorder = flight.FlightRecorder(capacity=8)
+    error_ids = []
+    for i in range(3):
+        trace = RequestTrace(tracing.new_trace_id())
+        error_ids.append(trace.trace_id)
+        assert recorder.observe(trace, status=500, duration_s=0.01) == "error"
+    for i in range(100):
+        trace = RequestTrace(tracing.new_trace_id())
+        assert recorder.observe(trace, status=200, duration_s=1.0) == "slow"
+    kept = {r["trace_id"]: r["class"] for r in recorder.snapshot()}
+    for trace_id in error_ids:
+        assert kept[trace_id] == "error"
+    assert len(kept) <= 8
+
+
+def test_flight_concurrency_8_writers(monkeypatch):
+    """8 writer threads: the ring stays bounded, no span tree is ever torn
+    (every span in a kept record carries that record's trace_id), and
+    errored traces survive the concurrent slow flood."""
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.1")
+    recorder = flight.FlightRecorder(capacity=16)
+    n_threads, per_thread = 8, 50
+    stop = threading.Event()
+    torn = []
+
+    def writer(thread_idx):
+        for i in range(per_thread):
+            trace = RequestTrace(tracing.new_trace_id())
+            parent = None
+            for name in ("serve_request", "serve_predict", "serve_encode"):
+                span_id = tracing.new_span_id()
+                trace.add(
+                    SpanRecord(
+                        name, trace.trace_id, span_id, parent, 0.0, 0.001
+                    )
+                )
+                parent = span_id
+            if i % 5 == 0:
+                recorder.observe(trace, status=500, duration_s=0.01)
+            else:
+                recorder.observe(trace, status=200, duration_s=0.5)
+
+    def reader():
+        while not stop.is_set():
+            for record in recorder.snapshot():
+                bad = [
+                    s for s in record["spans"]
+                    if s["trace_id"] != record["trace_id"]
+                ]
+                if bad:
+                    torn.append((record["trace_id"], bad))
+            recorder.chrome_trace()
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(n_threads)
+    ]
+    observer = threading.Thread(target=reader)
+    observer.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    observer.join()
+
+    assert not torn
+    records = recorder.snapshot()
+    assert 0 < len(records) <= 16
+    assert recorder.seen == n_threads * per_thread
+    classes = {r["class"] for r in records}
+    assert "error" in classes  # errors survived the slow majority
+    for record in records:
+        names = [s["name"] for s in record["spans"]]
+        assert names == ["serve_request", "serve_predict", "serve_encode"]
+    # occupancy gauges reflect the per-class rings
+    held_err = metric_catalog.FLIGHT_OCCUPANCY.value(cls="error")
+    held_slow = metric_catalog.FLIGHT_OCCUPANCY.value(cls="slow")
+    assert held_err == len([r for r in records if r["class"] == "error"])
+    assert held_slow == len([r for r in records if r["class"] == "slow"])
+
+
+# -------------------------------------------------------------- JSON logs
+def test_json_log_formatter_stamps_trace_ids():
+    import io
+
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.addFilter(logs.TraceContextFilter())
+    handler.setFormatter(logs.JsonLogFormatter())
+    log = logging.getLogger("test_tracing.json")
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    try:
+        log.info("outside any trace")
+        with tracing.request_root(None) as root:
+            with telemetry.span("serve_request"):
+                log.warning("inside %s", "a trace")
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            log.exception("with traceback")
+    finally:
+        log.removeHandler(handler)
+    lines = [json.loads(l) for l in stream.getvalue().strip().splitlines()]
+    assert lines[0]["message"] == "outside any trace"
+    assert "trace_id" not in lines[0]
+    assert lines[1]["message"] == "inside a trace"
+    assert lines[1]["trace_id"] == root.trace_id
+    assert lines[1]["span_id"]  # the serve_request span was ambient
+    assert lines[1]["level"] == "WARNING"
+    assert "ValueError: boom" in lines[2]["exc"]
+
+
+def test_maybe_configure_respects_knob(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_LOG_FORMAT", raising=False)
+    assert logs.maybe_configure() is False
+    monkeypatch.setenv("GORDO_TPU_LOG_FORMAT", "json")
+    root = logging.getLogger()
+    before_handlers = list(root.handlers)
+    before_formatters = [h.formatter for h in before_handlers]
+    try:
+        assert logs.maybe_configure() is True
+        assert any(
+            isinstance(h.formatter, logs.JsonLogFormatter)
+            for h in root.handlers
+        )
+    finally:
+        for handler in list(root.handlers):
+            if handler not in before_handlers:
+                root.removeHandler(handler)
+        for handler, formatter in zip(before_handlers, before_formatters):
+            handler.setFormatter(formatter)
+            for f in list(handler.filters):
+                if isinstance(f, logs.TraceContextFilter):
+                    handler.removeFilter(f)
+
+
+# --------------------------------------------------------- debug endpoints
+@pytest.fixture()
+def app(model_collection_directory, trained_model_directories):
+    from gordo_tpu.server import utils as server_utils
+    from gordo_tpu.server.server import build_app
+
+    server_utils.clear_model_caches()
+    return build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+
+
+def test_debug_endpoints_gated_then_live(app, monkeypatch):
+    client = app.test_client()
+    for path in ("/debug/flight", "/debug/vars", "/debug/config"):
+        assert client.get(path).status_code == 404, path
+
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    resp = client.get("/debug/flight")
+    assert resp.status_code == 200
+    body = resp.get_json()
+    assert "traceEvents" in body and "gordoFlight" in body
+
+    body = client.get("/debug/vars").get_json()
+    assert "gordo_server_flight_traces" in body["metrics"]
+    assert body["server"]["inflight_requests"] >= 1  # this request
+    assert "flight" in body
+
+    monkeypatch.setenv("GORDO_TPU_POSTGRES_PASSWORD", "hunter2")
+    monkeypatch.setenv("GORDO_TPU_MAX_INFLIGHT", "3")
+    body = client.get("/debug/config").get_json()
+    assert body["env"]["GORDO_TPU_POSTGRES_PASSWORD"] == "<redacted>"
+    assert body["env"]["GORDO_TPU_MAX_INFLIGHT"] == "3"
+    assert body["resolved"]["max_inflight"] == 3
+    assert body["resolved"]["debug_endpoints"] is True
+
+
+# ------------------------------------------------- the deterministic e2e
+def test_wedged_fuse_trace_in_flight_recorder_e2e(
+    app, gordo_project, gordo_name, monkeypatch
+):
+    """ISSUE 5 acceptance: fault-plan wedge + concurrent clients → the
+    wedged requests' full span trees are retrievable from /debug/flight
+    (root request span, batcher queue span, device-call span with
+    span-links to co-fused riders), the trace_id matches both the
+    X-Gordo-Trace response header and the JSON log capture."""
+    import io
+
+    from gordo_tpu.server import batcher as batcher_mod
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    # every request that waits out the 0.8s wedge counts as slow
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.25")
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        json.dumps(
+            {
+                "rules": [
+                    {
+                        "site": "serve_device_call",
+                        "times": 1,
+                        "error": "wedge",
+                        "seconds": 0.8,
+                    }
+                ]
+            }
+        ),
+    )
+    faults.reset_plan()
+    flight.reset()
+
+    # JSON log capture on the server logger (what an operator's log
+    # pipeline would ingest with GORDO_TPU_LOG_FORMAT=json)
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.addFilter(logs.TraceContextFilter())
+    handler.setFormatter(logs.JsonLogFormatter())
+    server_logger = logging.getLogger("gordo_tpu.server.server")
+    old_level = server_logger.level
+    server_logger.addHandler(handler)
+    server_logger.setLevel(logging.DEBUG)
+
+    n_clients = 4
+    trace_ids = [tracing.new_trace_id() for _ in range(n_clients)]
+    responses = [None] * n_clients
+    X = np.random.RandomState(0).rand(20, 4).tolist()
+    body = json.dumps({"X": X}).encode()
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    barrier = threading.Barrier(n_clients)
+
+    def post(i):
+        client = app.test_client()
+        barrier.wait()
+        responses[i] = client.post(
+            path,
+            data=body,
+            content_type="application/json",
+            headers={
+                "traceparent": f"00-{trace_ids[i]}-{'cd' * 8}-01"
+            },
+        )
+
+    try:
+        threads = [
+            threading.Thread(target=post, args=(i,))
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        server_logger.removeHandler(handler)
+        server_logger.setLevel(old_level)
+
+    # every request succeeded (the wedge delays, it does not fail) and
+    # echoed ITS trace id back
+    for i, resp in enumerate(responses):
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        assert resp.headers["X-Gordo-Trace"] == trace_ids[i]
+
+    # the flight recorder kept the wedged requests as slow exemplars
+    flight_doc = app.test_client().get("/debug/flight").get_json()
+    kept = {r["trace_id"]: r for r in flight_doc["gordoFlight"]}
+    wedged_ids = [t for t in trace_ids if t in kept]
+    assert wedged_ids, (trace_ids, list(kept))
+
+    events_by_trace = {}
+    for event in flight_doc["traceEvents"]:
+        events_by_trace.setdefault(
+            event["args"]["trace_id"], {}
+        ).setdefault(event["name"], []).append(event)
+
+    linked_riders = set()
+    for trace_id in wedged_ids:
+        spans = events_by_trace[trace_id]
+        # full tree: root request span, batcher queue span, device call
+        assert "serve_request" in spans, spans.keys()
+        assert "serve_batch_queue" in spans, spans.keys()
+        assert "serve_device_call" in spans, spans.keys()
+        (root,) = spans["serve_request"]
+        # the root continued OUR traceparent: its parent is the client span
+        assert root["args"]["parent_span_id"] == "cd" * 8
+        (queue,) = spans["serve_batch_queue"]
+        (call,) = spans["serve_device_call"]
+        # the device call is parented under the rider's queue span
+        assert call["args"]["parent_span_id"] == queue["args"]["span_id"]
+        for link in call["args"].get("links", "").split(","):
+            if link:
+                linked_riders.add(link.split(":")[0])
+
+    # at least one fused call carried span-links, and every link names
+    # another of OUR requests — one slow fuse explains N slow requests
+    assert linked_riders, "no device-call span carried span-links"
+    assert linked_riders <= set(trace_ids)
+    assert any(
+        link_target != trace_id
+        for trace_id in wedged_ids
+        for link_target in linked_riders
+    )
+
+    # the JSON log capture carries the same trace ids
+    logged = [
+        json.loads(line) for line in stream.getvalue().strip().splitlines()
+    ]
+    logged_ids = {entry.get("trace_id") for entry in logged}
+    for trace_id in wedged_ids:
+        assert trace_id in logged_ids, (logged_ids, trace_id)
