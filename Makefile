@@ -40,4 +40,15 @@ render-gate:
 bench:
 	python bench.py
 
-.PHONY: image push test dryrun smoke render-gate bench
+# hard perf regression gate: diff the two most recent BENCH_r*.json
+# records with comparable-section matching (exit 1 on a >15% regression;
+# see docs/benchmarking.md "Reading the gate")
+bench-gate:
+	python scripts/bench_compare.py --latest .
+
+# schema check on every checked-in bench record (also runs in tier-1)
+lint-bench-records:
+	python scripts/lint_bench_record.py
+
+.PHONY: image push test dryrun smoke render-gate bench bench-gate \
+	lint-bench-records

@@ -478,6 +478,98 @@ def _bench_batch_ab() -> dict:
     return out
 
 
+def _bench_serving_load() -> dict:
+    """The closed-loop load-harness section: a live HTTP server (tiny
+    just-built model, flight recorder on) driven by the real load
+    generator (benchmarks/load_test.py) in its open-loop QPS mode —
+    coordinated-omission-safe tail percentiles from merged log-bucketed
+    histograms — plus a short concurrency ramp. Ends with the span trees
+    of the run's worst requests pulled from ``/debug/flight``: not just
+    "p99.9 was X ms" but where those requests spent it.
+
+    Knobs (documented in docs/configuration.md):
+    ``GORDO_TPU_BENCH_LOAD_QPS`` (50), ``GORDO_TPU_BENCH_LOAD_SECONDS``
+    (6), ``GORDO_TPU_BENCH_LOAD_WARMUP_S`` (1),
+    ``GORDO_TPU_BENCH_LOAD_USERS`` (4).
+    """
+    import tempfile
+    import threading
+    import wsgiref.simple_server
+
+    from gordo_tpu import serializer
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.server.server import build_app
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"),
+    )
+    import load_test
+
+    # the debug surface must be up for the worst-request cross-check, the
+    # slow threshold low enough that the tail of a healthy run is actually
+    # recorded (server-side wall is what the recorder sees; the harness's
+    # open-loop latencies include queueing the server doesn't), and the
+    # ring deep enough that early keeps survive the run
+    # (setdefault throughout: an operator's explicit setting wins)
+    os.environ.setdefault("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    os.environ.setdefault("GORDO_TPU_FLIGHT_SLOW_S", "0.005")
+    os.environ.setdefault("GORDO_TPU_FLIGHT_CAPACITY", "1024")
+
+    qps = float(os.environ.get("GORDO_TPU_BENCH_LOAD_QPS", "50"))
+    duration = float(os.environ.get("GORDO_TPU_BENCH_LOAD_SECONDS", "6"))
+    warmup = float(os.environ.get("GORDO_TPU_BENCH_LOAD_WARMUP_S", "1"))
+    users = int(os.environ.get("GORDO_TPU_BENCH_LOAD_USERS", "4"))
+
+    # one reference-shaped machine, served for real over HTTP
+    machine = Machine.from_config(
+        _machine_config("load-serve"), project_name="bench"
+    )
+    model, machine_out = ModelBuilder(machine).build()
+    collection = os.path.join(tempfile.mkdtemp(prefix="bench-load-"), "rev-1")
+    model_dir = os.path.join(collection, machine_out.name)
+    os.makedirs(model_dir)
+    serializer.dump(model, model_dir, metadata=machine_out.to_dict())
+
+    class _Quiet(wsgiref.simple_server.WSGIRequestHandler):
+        def log_message(self, *args):
+            pass
+
+    app = build_app({"MODEL_COLLECTION_DIR": collection})
+    server = wsgiref.simple_server.make_server(
+        "127.0.0.1", 0, app, handler_class=_Quiet
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    host = f"http://127.0.0.1:{server.server_port}"
+    try:
+        out = {
+            "qps": load_test.run(
+                host=host, project="bench", machine=machine_out.name,
+                mode="qps", qps=qps, users=users, duration=duration,
+                warmup=warmup, samples=100, flight=True,
+            )
+        }
+        # partial envelope: a leash kill after the QPS phase keeps it
+        import jax
+
+        print(
+            json.dumps(
+                {"platform": jax.devices()[0].platform, "result": out}
+            ),
+            flush=True,
+        )
+        out["ramp"] = load_test.run(
+            host=host, project="bench", machine=machine_out.name,
+            mode="ramp", ramp_users=[1, 2, 4],
+            duration=max(1.0, duration / 3), warmup=min(warmup, 0.5),
+            samples=100, flight=False,
+        )
+    finally:
+        server.shutdown()
+    return out
+
+
 def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     """
     BASELINE metric #2: server samples/sec + p50 anomaly latency.
@@ -626,9 +718,17 @@ def _wedge_degraded(section: dict) -> bool:
 
 
 def _degraded_sections(sections: dict) -> list:
-    """Section names the recovery pass should re-run (disabled sections are
-    empty and skipped)."""
-    return [n for n, s in sections.items() if _wedge_degraded(s)]
+    """Section names the recovery pass should re-run: wedge-degraded ones
+    (CPU fallback / hang) AND budget-skipped ones — round-5 advisor
+    finding: when the driver's real leash outlives the governor's budget,
+    a ``skipped_for_budget`` section is a free measurement the recovery
+    pass was silently throwing away. The per-section remaining-wall check
+    in the rerun loop still guards each rerun against the recovery
+    deadline. Disabled sections are empty and skipped."""
+    return [
+        n for n, s in sections.items()
+        if _wedge_degraded(s) or bool(s.get("skipped_for_budget"))
+    ]
 
 
 def _rerun_improves(rerun: dict, original: dict) -> bool:
@@ -636,13 +736,47 @@ def _rerun_improves(rerun: dict, original: dict) -> bool:
 
     An accelerated, error-free rerun always wins. A rerun that degraded to
     CPU again (tunnel re-wedged) only wins when the original is an error
-    entry — a completed CPU measurement beats no measurement, but never
-    replaces one."""
+    or budget-skip entry — a completed CPU measurement beats no
+    measurement, but never replaces one."""
     if "error" in rerun or rerun.get("platform") is None:
         return False
     if rerun.get("platform") != "cpu":
         return True
-    return "error" in original
+    return "error" in original or bool(original.get("skipped_for_budget"))
+
+
+# ------------------------------------------------------ section contract
+# The harness is a fixed set of sections; EVERY run's record accounts for
+# every one of them with an explicit status (schema v2 — validated by
+# scripts/lint_bench_record.py and consumed by scripts/bench_compare.py's
+# comparable-section matching). serving_load runs right after the smoke so
+# budget pressure can't cost the round its tail-latency record.
+SECTION_NAMES = (
+    "tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+)
+SECTION_STATUSES = (
+    "completed", "skipped_for_budget", "failed", "timeout", "disabled",
+)
+RECORD_SCHEMA_VERSION = 2
+
+
+def _section_status(entry: dict) -> str:
+    """The explicit status of a section record entry (schema v2). Entries
+    produced before the status field (recovered partials, tests) are
+    classified from their legacy shape."""
+    if not entry:
+        return "disabled"
+    if "status" in entry:
+        return entry["status"]
+    if entry.get("skipped_for_budget"):
+        return "skipped_for_budget"
+    if entry.get("hung"):
+        return "timeout"
+    if "error" in entry:
+        return "failed"
+    if "result" in entry:
+        return "completed"
+    return "disabled"
 
 
 # Minimum wall a section needs to produce ANY useful record (probe budget +
@@ -650,6 +784,7 @@ def _rerun_improves(rerun: dict, original: dict) -> bool:
 # than hand it a leash shorter than this.
 _SECTION_MIN_USEFUL = {
     "tpu_smoke": 120,
+    "serving_load": 120,
     "headline": 600,
     "windowed": 600,
     "batch_ab": 300,
@@ -668,6 +803,13 @@ def _section_timeout(name: str) -> int:
     if name == "tpu_smoke" and "BENCH_SECTION_TIMEOUT_TPU_SMOKE" not in os.environ:
         # the smoke is deliberately tiny — it must never eat the budget the
         # fleet sections need, even when the generic knob is raised
+        timeout = min(timeout, 900)
+    if (
+        name == "serving_load"
+        and "BENCH_SECTION_TIMEOUT_SERVING_LOAD" not in os.environ
+    ):
+        # one tiny model build + a few fixed-length load windows — like the
+        # smoke, it must never starve the fleet sections
         timeout = min(timeout, 900)
     if name == "headline" and "BENCH_SECTION_TIMEOUT_HEADLINE" not in os.environ:
         # the headline gets a longer leash regardless of the generic knob: a
@@ -704,6 +846,16 @@ def _run_section(
     env = None
     if extra_env:
         env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}}
+    t_start = time.time()
+
+    def finish(entry: dict, status: str) -> dict:
+        # the status contract: every entry that leaves this function names
+        # its outcome explicitly — the record schema's per-section field
+        entry["status"] = status
+        entry["wall_sec"] = round(time.time() - t_start, 1)
+        entry["timeout_s"] = timeout
+        return entry
+
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--section", name],
@@ -722,30 +874,41 @@ def _run_section(
                 if is_out:
                     out_text = text
                 sys.stderr.write(text[-2000:])
-        return _with_partial(
-            {
-                "error": f"section {name} hung past {timeout}s "
-                         "(device wedge?)",
-                "hung": True,
-            },
-            out_text,
+        return finish(
+            _with_partial(
+                {
+                    "error": f"section {name} hung past {timeout}s "
+                             "(device wedge?)",
+                    "hung": True,
+                },
+                out_text,
+            ),
+            "timeout",
         )
     sys.stderr.write(proc.stderr[-2000:])
     if proc.returncode != 0:
         # a crashed/killed child (OOM, SIGKILL) may still have printed
         # phase partials before dying — recover them like the timeout path
-        return _with_partial(
-            {"error": f"section {name} exit {proc.returncode}: "
-                      + proc.stderr.strip()[-300:]},
-            proc.stdout,
+        return finish(
+            _with_partial(
+                {"error": f"section {name} exit {proc.returncode}: "
+                          + proc.stderr.strip()[-300:]},
+                proc.stdout,
+            ),
+            "failed",
         )
     try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])
+        return finish(
+            json.loads(proc.stdout.strip().splitlines()[-1]), "completed"
+        )
     except Exception:  # noqa: BLE001
-        return _with_partial(
-            {"error": f"section {name} unparseable output: "
-                      + proc.stdout.strip()[-300:]},
-            proc.stdout,
+        return finish(
+            _with_partial(
+                {"error": f"section {name} unparseable output: "
+                          + proc.stdout.strip()[-300:]},
+                proc.stdout,
+            ),
+            "failed",
         )
 
 
@@ -1059,6 +1222,7 @@ def _section_child(name: str) -> None:
     _setup_backend(sys.argv)
     sections = {
         "tpu_smoke": _bench_tpu_smoke,
+        "serving_load": _bench_serving_load,
         "headline": _bench_headline,
         "windowed": _bench_windowed,
         "batch_ab": _bench_batch_ab,
@@ -1138,16 +1302,29 @@ def main():
     deadline = t_start + total_budget
     accel_expected = os.environ.get("JAX_PLATFORMS", "") != "cpu"
 
-    enabled = ["tpu_smoke", "headline", "windowed", "batch_ab"]
-    if os.environ.get("BENCH_TPU_SMOKE", "1") == "0":
-        enabled.remove("tpu_smoke")
-    if os.environ.get("BENCH_WINDOWED", "1") == "0":
-        enabled.remove("windowed")
-    if os.environ.get("BENCH_BATCH_AB", "1") == "0":
-        enabled.remove("batch_ab")
+    enabled = list(SECTION_NAMES)
+    # GORDO_TPU_BENCH_SECTIONS: comma-list selecting sections to run (the
+    # operator-facing way to target one section); unset = all, then the
+    # legacy per-section disable knobs apply
+    selector = os.environ.get("GORDO_TPU_BENCH_SECTIONS")
+    if selector:
+        requested = {s.strip() for s in selector.split(",") if s.strip()}
+        enabled = [n for n in SECTION_NAMES if n in requested]
+    else:
+        if os.environ.get("BENCH_TPU_SMOKE", "1") == "0":
+            enabled.remove("tpu_smoke")
+        if os.environ.get("BENCH_SERVING_LOAD", "1") == "0":
+            enabled.remove("serving_load")
+        if os.environ.get("BENCH_WINDOWED", "1") == "0":
+            enabled.remove("windowed")
+        if os.environ.get("BENCH_BATCH_AB", "1") == "0":
+            enabled.remove("batch_ab")
 
+    # every canonical section appears in the record, disabled ones
+    # included — "no section unaccounted for" is the schema's core promise
     sections: dict = {
-        n: {} for n in ("tpu_smoke", "headline", "windowed", "batch_ab")
+        n: ({} if n in enabled else {"status": "disabled"})
+        for n in SECTION_NAMES
     }
 
     def shed_env(*prior: dict) -> dict:
@@ -1172,7 +1349,8 @@ def main():
                 f"{total_budget}s budget, {reserve}s reserved for {later}",
                 file=sys.stderr,
             )
-            return {"skipped_for_budget": True,
+            return {"status": "skipped_for_budget",
+                    "skipped_for_budget": True,
                     "remaining_sec": round(remaining)}
         return _run_section(
             name, extra_env=shed_env(*prior),
@@ -1181,11 +1359,21 @@ def main():
 
     prior: list = []
     for name in enabled:
-        sections[name] = run_governed(name, *prior)
-        prior.append(sections[name])
-        # emit after EVERY section — the last stdout line is always the
-        # best-so-far record in the final format
-        _emit_record(sections, [])
+        # try/finally per section: even an orchestrator-side crash (a bug
+        # in the governor, a MemoryError) leaves this section accounted
+        # for and the best-so-far record as the last stdout line
+        try:
+            sections[name] = run_governed(name, *prior)
+        except Exception as exc:  # noqa: BLE001 — the record must survive
+            sections[name] = {
+                "status": "failed",
+                "error": f"orchestrator error in {name}: {exc!r}"[:300],
+            }
+        finally:
+            prior.append(sections[name])
+            # emit after EVERY section — the last stdout line is always
+            # the best-so-far record in the final format
+            _emit_record(sections, [])
 
     # Recovery pass: the round-3 postmortem's failure mode is a tunnel wedge
     # at bench time surrendering the whole record to CPU. The wedge is
@@ -1229,33 +1417,48 @@ def main():
                 f"{degraded}", file=sys.stderr,
             )
             reruns: list = []
-            for n in degraded:
-                # re-check the budget per section: reruns are serial and the
-                # headline alone can hold a 3600s leash — one pre-loop check
-                # could blow hours past the budget on a re-wedged tunnel.
-                # `continue`, not `break`: minimums differ per section, so a
-                # later, cheaper section may still fit what this one can't
-                remaining = int(recovery_deadline - time.time())
-                if remaining < _SECTION_MIN_USEFUL[n]:
-                    print(
-                        f"# recovery budget too low for {n} rerun "
-                        f"({remaining}s < {_SECTION_MIN_USEFUL[n]}s); "
-                        f"skipping it", file=sys.stderr,
-                    )
-                    continue
-                # first rerun probes with full retries (the recovery probe
-                # just succeeded); once a RERUN itself re-degrades, later
-                # reruns shed to one probe — same logic as the first pass
-                rerun = _run_section(
-                    n, extra_env=shed_env(*reruns),
-                    timeout=min(_section_timeout(n), remaining),
+            try:
+                _recovery_reruns(
+                    degraded, sections, reruns, recovered,
+                    recovery_deadline, shed_env,
                 )
-                reruns.append(rerun)
-                if _rerun_improves(rerun, sections[n]):
-                    sections[n] = rerun
-                    recovered.append(n)
-                    # adopt incrementally for the same kill-safety reason
-                    _emit_record(sections, recovered)
+            finally:
+                # the recovery pass may be killed by the driver's outer
+                # leash at any point; the final line must still carry the
+                # full per-section accounting
+                _emit_record(sections, recovered)
+
+
+def _recovery_reruns(
+    degraded, sections, reruns, recovered, recovery_deadline, shed_env
+):
+    for n in degraded:
+        # re-check the budget per section: reruns are serial and the
+        # headline alone can hold a 3600s leash — one pre-loop check
+        # could blow hours past the budget on a re-wedged tunnel.
+        # `continue`, not `break`: minimums differ per section, so a
+        # later, cheaper section may still fit what this one can't
+        remaining = int(recovery_deadline - time.time())
+        if remaining < _SECTION_MIN_USEFUL[n]:
+            print(
+                f"# recovery budget too low for {n} rerun "
+                f"({remaining}s < {_SECTION_MIN_USEFUL[n]}s); "
+                f"skipping it", file=sys.stderr,
+            )
+            continue
+        # first rerun probes with full retries (the recovery probe
+        # just succeeded); once a RERUN itself re-degrades, later
+        # reruns shed to one probe — same logic as the first pass
+        rerun = _run_section(
+            n, extra_env=shed_env(*reruns),
+            timeout=min(_section_timeout(n), remaining),
+        )
+        reruns.append(rerun)
+        if _rerun_improves(rerun, sections[n]):
+            sections[n] = rerun
+            recovered.append(n)
+            # adopt incrementally for the same kill-safety reason
+            _emit_record(sections, recovered)
 
 
 def _emit_record(sections: dict, recovered: list):
@@ -1267,6 +1470,7 @@ def _emit_record(sections: dict, recovered: list):
     windowed = sections.get("windowed") or {}
     batch_ab = sections.get("batch_ab") or {}
     smoke = sections.get("tpu_smoke") or {}
+    serving_load = sections.get("serving_load") or {}
     head = headline.get("result") or {}
 
     serving = head.get("serving", {})
@@ -1286,10 +1490,15 @@ def _emit_record(sections: dict, recovered: list):
     detail = {
         **head,
         "tpu_smoke": smoke,
+        "serving_load": serving_load,
         "windowed": windowed,
         "batch_ab": batch_ab,
         "platform": headline.get("platform", "unknown"),
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
+        "sections": {
+            name: _section_status(entry)
+            for name, entry in sections.items()
+        },
     }
     if recovered:
         # the detail record must also show which sections are recovery-pass
@@ -1306,7 +1515,11 @@ def _emit_record(sections: dict, recovered: list):
     win = windowed.get("result") or {}
     ab = batch_ab.get("result") or {}
     smoke_res = smoke.get("result") or {}
+    load_res = serving_load.get("result") or {}
+    load_qps = load_res.get("qps") or {}
+    load_flight = load_qps.get("flight") or {}
     out = {
+        "schema_version": RECORD_SCHEMA_VERSION,
         "metric": "autoencoder machines/min trained (4-tag hourglass AE, "
         "3-fold CV + thresholds, 1008 rows); server anomaly POST "
         "(100 samples x 4 tags)",
@@ -1323,6 +1536,21 @@ def _emit_record(sections: dict, recovered: list):
         "server_d2h_floor_ms": serving.get("d2h_floor_ms"),
         "server_p50_net_of_floor_ms": serving.get("p50_net_of_floor_ms"),
         "serving_source": serving_source,
+        # the open-loop load section's tail percentiles (flat keys so
+        # bench_compare.py gates on them like any headline metric)
+        "server_load_req_per_sec": load_qps.get("req_per_sec"),
+        "server_load_p50_ms": load_qps.get("p50_ms"),
+        "server_load_p99_ms": load_qps.get("p99_ms"),
+        "server_load_p999_ms": load_qps.get("p999_ms"),
+        "serving_load": {
+            "platform": serving_load.get("platform"),
+            "qps_target": load_qps.get("qps_target"),
+            "errors": load_qps.get("errors"),
+            "worst_traces": [
+                w.get("trace_id")
+                for w in (load_flight.get("worst_requests") or [])[:3]
+            ],
+        },
         "tpu_smoke": {
             "platform": smoke.get("platform"),
             "flash_ok": (smoke_res.get("flash") or {}).get("ok"),
@@ -1352,6 +1580,13 @@ def _emit_record(sections: dict, recovered: list):
             },
         },
         "detail_file": detail_file,
+        # schema v2: every canonical section accounted for with an
+        # explicit status — the lie rc=124 used to tell ("this section
+        # never existed") is no longer expressible
+        "sections": {
+            name: _section_status(entry)
+            for name, entry in sections.items()
+        },
     }
     if recovered:
         out["recovered_sections"] = recovered

@@ -1,24 +1,55 @@
 """
-Threaded load generator against a live model server.
+Load generator against a live model server: closed-loop users, open-loop
+constant QPS, and concurrency ramps — with trustworthy tail latencies.
 
 Reference parity: benchmarks/load_test/load_test.py:62-96 — the locust
 harness fetches the deployed server's metadata to learn each model's tag
-list, then drives concurrent prediction POSTs. locust isn't in the image, so
-concurrency comes from a thread pool and results are aggregated here.
+list, then drives concurrent prediction POSTs. locust isn't in the image,
+so concurrency comes from a thread pool and results are aggregated here —
+but this harness goes where locust's default accounting doesn't:
+
+- **Open-loop QPS mode** (``--mode qps --qps N``) measures every request
+  from its *intended* send time on a fixed schedule, so a server stall
+  shows up as queueing delay in p99 instead of silently pausing the
+  request stream (coordinated omission). Workers are a concurrency cap,
+  not the request clock.
+- **Closed-loop mode** (``--mode closed``, the default) is the classic
+  N-users-in-a-loop driver; ``--expected-interval-ms`` optionally applies
+  the HdrHistogram back-fill correction to its recordings.
+- **Ramp mode** (``--mode ramp --ramp-users 1,2,4,8``) steps concurrency
+  up and reports each step separately — where does throughput flatten and
+  p99 blow up.
+
+Latencies go into log-bucketed histograms
+(``gordo_tpu.observability.latency``) — one per worker thread, merged
+after the run — reporting p50/p90/p95/p99/p99.9 with a documented
+relative error bound. Server-Timing phase entries (decode/predict/encode,
+PR 2) feed per-phase histograms, so a slow run says *where* the time
+went. The slowest requests' ``X-Gordo-Trace`` ids are kept, and when the
+server exposes the PR-5 flight recorder (``GORDO_TPU_DEBUG_ENDPOINTS=1``)
+the run ends by pulling ``/debug/flight`` and attaching the span trees of
+its worst requests to the report.
 
 Usage:
     PYTHONPATH=. python benchmarks/load_test.py --host http://localhost:5555 \
-        --project my-project [--machine NAME] [--users 8] [--duration 30]
+        --project my-project [--machine NAME] [--mode closed|qps|ramp] \
+        [--qps 100] [--users 8] [--duration 30] [--warmup 3]
 """
 
 import argparse
+import heapq
 import json
-import statistics
 import sys
 import threading
 import time
 import urllib.error
 import urllib.request
+
+from gordo_tpu.observability.latency import LatencyHistogram
+
+# how many slowest-request trace ids each worker retains for the
+# flight-recorder cross-check
+DEFAULT_TOP_SLOW = 5
 
 
 def _get_json(url: str):
@@ -43,24 +74,370 @@ def discover(host: str, project: str, machine: str = None):
     return machine, tags
 
 
-def worker(
-    url: str, body: bytes, stop_at: float, out: list, errors: list,
-    headers: dict,
-):
-    while time.monotonic() < stop_at:
-        start = time.monotonic()
+def _parse_server_timing(header: str) -> dict:
+    """``request_walltime_s;dur=0.012, decode_s;dur=0.001`` → seconds per
+    phase, ``_s`` suffix stripped."""
+    phases = {}
+    for raw in (header or "").split(","):
+        name, sep, dur = raw.strip().partition(";dur=")
+        if not sep or not name.endswith("_s"):
+            continue
         try:
-            req = urllib.request.Request(url, data=body, headers=headers)
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            phases[name[:-2]] = float(dur)
+        except ValueError:
+            continue
+    return phases
+
+
+def http_send_factory(url: str, body: bytes, headers: dict, timeout: float = 60.0):
+    """The real transport: one POST per call. Returns
+    ``(error, trace_id, phases)`` — error None on 2xx, an HTTP status code
+    or short repr otherwise; phases from the Server-Timing header."""
+
+    def send():
+        req = urllib.request.Request(url, data=body, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 resp.read()
+                return (
+                    None,
+                    resp.headers.get("X-Gordo-Trace"),
+                    _parse_server_timing(resp.headers.get("Server-Timing")),
+                )
         except urllib.error.HTTPError as exc:
-            # non-2xx raises; record the status code, not the exception repr
-            errors.append(exc.code)
-            continue
+            trace_id = exc.headers.get("X-Gordo-Trace") if exc.headers else None
+            exc.close()
+            return exc.code, trace_id, {}
         except Exception as exc:  # noqa: BLE001 — live-server bench, record+go on
-            errors.append(repr(exc))
-            continue
-        out.append(time.monotonic() - start)
+            return repr(exc)[:160], None, {}
+
+    return send
+
+
+class WorkerStats:
+    """One worker thread's private accounting — no locks on the hot path;
+    merged across workers after the run."""
+
+    def __init__(self, top_slow: int = DEFAULT_TOP_SLOW):
+        self.hist = LatencyHistogram()
+        self.phase_hists: dict = {}
+        self.errors: list = []
+        self.slowest: list = []  # min-heap of (latency_s, trace_id)
+        self.top_slow = top_slow
+        self.requests = 0
+        self.warmup_requests = 0
+
+    def observe(
+        self, latency_s, error, trace_id, phases,
+        measured: bool, expected_interval_s=None,
+    ):
+        if error is not None:
+            self.errors.append(error)
+            return
+        if not measured:
+            self.warmup_requests += 1
+            return
+        self.requests += 1
+        if expected_interval_s:
+            self.hist.record_with_expected_interval(
+                latency_s, expected_interval_s
+            )
+        else:
+            self.hist.record(latency_s)
+        for name, duration in phases.items():
+            hist = self.phase_hists.get(name)
+            if hist is None:
+                hist = self.phase_hists.setdefault(name, LatencyHistogram())
+            hist.record(duration)
+        if trace_id:
+            heapq.heappush(self.slowest, (latency_s, trace_id))
+            if len(self.slowest) > self.top_slow:
+                heapq.heappop(self.slowest)
+
+
+def _run_threads(worker, stats_list):
+    threads = [
+        threading.Thread(target=worker, args=(stats,), daemon=True)
+        for stats in stats_list
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def run_closed(
+    send, users: int, duration: float, warmup: float = 0.0,
+    expected_interval_s=None, top_slow: int = DEFAULT_TOP_SLOW,
+):
+    """Classic closed loop: each worker fires as fast as responses return.
+    Latency = request start → done. Requests starting inside the warmup
+    window are issued but not measured."""
+    stats_list = [WorkerStats(top_slow) for _ in range(users)]
+    t0 = time.monotonic()
+    measure_start = t0 + warmup
+    stop_at = measure_start + duration
+
+    def worker(stats):
+        while True:
+            start = time.monotonic()
+            if start >= stop_at:
+                return
+            error, trace_id, phases = send()
+            latency = time.monotonic() - start
+            stats.observe(
+                latency, error, trace_id, phases,
+                measured=start >= measure_start,
+                expected_interval_s=expected_interval_s,
+            )
+
+    _run_threads(worker, stats_list)
+    wall = time.monotonic() - measure_start
+    return stats_list, max(wall, 1e-9)
+
+
+def run_open(
+    send, users: int, qps: float, duration: float, warmup: float = 0.0,
+    top_slow: int = DEFAULT_TOP_SLOW,
+):
+    """Open-loop constant-QPS: requests are due at ``t0 + i/qps``
+    regardless of how the server is doing; latency is measured from that
+    *intended* send time. When all ``users`` workers are stuck waiting on
+    a stalled server, due requests queue up and — once a worker frees —
+    their latencies include the backlog they sat in. That is the
+    coordinated-omission-safe accounting: the schedule, not the server,
+    is the clock."""
+    stats_list = [WorkerStats(top_slow) for _ in range(users)]
+    total = max(1, int(round((warmup + duration) * qps)))
+    first_measured = int(round(warmup * qps))
+    t0 = time.monotonic()
+    lock = threading.Lock()
+    next_index = [0]
+
+    def worker(stats):
+        while True:
+            with lock:
+                i = next_index[0]
+                next_index[0] += 1
+            if i >= total:
+                return
+            intended = t0 + i / qps
+            now = time.monotonic()
+            if intended > now:
+                time.sleep(intended - now)
+            error, trace_id, phases = send()
+            latency = time.monotonic() - intended
+            stats.observe(
+                latency, error, trace_id, phases, measured=i >= first_measured
+            )
+
+    _run_threads(worker, stats_list)
+    # with a healthy server the measure window is exactly ``duration``;
+    # with a backlogged one it stretches to when the last response landed
+    wall = time.monotonic() - (t0 + warmup)
+    return stats_list, max(wall, duration, 1e-9)
+
+
+def _ms(value):
+    return None if value is None else round(value * 1e3, 3)
+
+
+def summarize(
+    stats_list, wall: float, samples_per_request: int,
+    top_slow: int = DEFAULT_TOP_SLOW,
+) -> dict:
+    """Merge per-worker histograms and render one report block."""
+    merged = LatencyHistogram.merged(s.hist for s in stats_list)
+    requests = sum(s.requests for s in stats_list)
+    errors = [e for s in stats_list for e in s.errors]
+
+    phase_names = sorted({n for s in stats_list for n in s.phase_hists})
+    phases = {}
+    for name in phase_names:
+        phist = LatencyHistogram.merged(
+            s.phase_hists[name] for s in stats_list if name in s.phase_hists
+        )
+        phases[name] = {
+            "p50_ms": _ms(phist.quantile(0.50)),
+            "p99_ms": _ms(phist.quantile(0.99)),
+        }
+
+    slowest = heapq.nlargest(
+        top_slow, (item for s in stats_list for item in s.slowest)
+    )
+    report = {
+        "requests": requests,
+        "errors": len(errors),
+        "error_sample": errors[:5],
+        "duration_sec": round(wall, 2),
+        "req_per_sec": round(requests / wall, 2),
+        "samples_per_sec": round(requests * samples_per_request / wall, 1),
+        "mean_ms": _ms(merged.summary()["mean_s"]),
+        "p50_ms": _ms(merged.quantile(0.50)),
+        "p90_ms": _ms(merged.quantile(0.90)),
+        "p95_ms": _ms(merged.quantile(0.95)),
+        "p99_ms": _ms(merged.quantile(0.99)),
+        "p999_ms": _ms(merged.quantile(0.999)),
+        "max_ms": _ms(merged.quantile(1.0)),
+        "latency_rel_error_bound": merged.error_bound,
+        "phases": phases,
+        "slowest": [
+            {"latency_ms": _ms(latency), "trace_id": trace_id}
+            for latency, trace_id in slowest
+        ],
+    }
+    if not report["error_sample"]:
+        del report["error_sample"]
+    return report
+
+
+# ------------------------------------------------- flight-recorder check
+def fetch_worst_traces(host: str, slowest: list) -> dict:
+    """Pull ``/debug/flight`` and return the span trees of the slowest
+    requests this run produced — the load harness's closing argument:
+    not just "p99.9 was 412ms" but "and here is where those requests
+    spent it". Degrades to a reason string when the debug surface is
+    gated off (GORDO_TPU_DEBUG_ENDPOINTS unset) or unreachable."""
+    wanted = {
+        entry["trace_id"]: entry["latency_ms"]
+        for entry in slowest
+        if entry.get("trace_id")
+    }
+    if not wanted:
+        return {"available": False, "reason": "no trace ids collected"}
+    try:
+        doc = _get_json(f"{host}/debug/flight")
+    except urllib.error.HTTPError as exc:
+        reason = f"HTTP {exc.code}"
+        if exc.code == 404:
+            reason += " (enable GORDO_TPU_DEBUG_ENDPOINTS=1 on the server)"
+        exc.close()
+        return {"available": False, "reason": reason}
+    except Exception as exc:  # noqa: BLE001 — the report survives a dead server
+        return {"available": False, "reason": repr(exc)[:160]}
+
+    summaries = {
+        record.get("trace_id"): record
+        for record in doc.get("gordoFlight", [])
+    }
+    spans_by_trace: dict = {}
+    for event in doc.get("traceEvents", []):
+        trace_id = (event.get("args") or {}).get("trace_id")
+        if trace_id in wanted:
+            spans_by_trace.setdefault(trace_id, []).append(
+                {
+                    "name": event.get("name"),
+                    "dur_ms": round(event.get("dur", 0.0) / 1e3, 3),
+                    "span_id": (event.get("args") or {}).get("span_id"),
+                    "parent_span_id": (event.get("args") or {}).get(
+                        "parent_span_id"
+                    ),
+                }
+            )
+    worst = []
+    for trace_id, latency_ms in sorted(
+        wanted.items(), key=lambda item: -(item[1] or 0)
+    ):
+        spans = sorted(
+            spans_by_trace.get(trace_id, []), key=lambda s: -s["dur_ms"]
+        )
+        summary = summaries.get(trace_id) or {}
+        worst.append(
+            {
+                "trace_id": trace_id,
+                "latency_ms": latency_ms,
+                "recorded": trace_id in spans_by_trace,
+                "class": summary.get("class"),
+                "status": summary.get("status"),
+                "spans": spans,
+            }
+        )
+    return {
+        "available": True,
+        "recorded": sum(1 for w in worst if w["recorded"]),
+        "worst_requests": worst,
+    }
+
+
+# ----------------------------------------------------------------- driver
+def run(
+    host: str, project: str, machine: str = None, mode: str = "closed",
+    users: int = 8, duration: float = 30.0, warmup: float = 0.0,
+    qps: float = None, ramp_users=None, samples: int = 100,
+    codec: str = None, expected_interval_ms: float = None,
+    flight: bool = True, top_slow: int = DEFAULT_TOP_SLOW, _send=None,
+) -> dict:
+    """One full load run against a live server; returns the report dict.
+    ``_send`` injects a fake transport for tests."""
+    import random
+
+    machine, tags = discover(host, project, machine)
+    X = [[random.random() for _ in tags] for _ in range(samples)]
+    body = json.dumps({"X": X, "y": X}).encode()
+    url = f"{host}/gordo/v0/{project}/{machine}/anomaly/prediction"
+    headers = {"Content-Type": "application/json"}
+    if codec:
+        headers["X-Gordo-Codec"] = codec
+    send = _send or http_send_factory(url, body, headers)
+
+    # one priming request outside any window so model-load/compile cost
+    # lands nowhere near the measurement (legacy behavior, kept)
+    error, _, _ = send()
+    if error is not None:
+        return {"error": f"warmup request failed: {error}"}
+
+    expected_interval_s = (
+        expected_interval_ms / 1e3 if expected_interval_ms else None
+    )
+    report = {
+        "machine": machine,
+        "mode": mode,
+        "codec": codec or "default",
+        "users": users,
+        "warmup_sec": warmup,
+        "samples_per_request": samples,
+    }
+    if mode == "qps":
+        if not qps or qps <= 0:
+            return {"error": "--mode qps requires --qps > 0"}
+        stats_list, wall = run_open(
+            send, users, qps, duration, warmup, top_slow
+        )
+        report["qps_target"] = qps
+        report.update(summarize(stats_list, wall, samples, top_slow))
+        all_slowest = report["slowest"]
+    elif mode == "ramp":
+        steps_spec = ramp_users or [1, 2, 4, 8]
+        steps = []
+        every_stats = []
+        for step_users in steps_spec:
+            stats_list, wall = run_closed(
+                send, step_users, duration, warmup,
+                expected_interval_s, top_slow,
+            )
+            step_report = summarize(stats_list, wall, samples, top_slow)
+            step_report["users"] = step_users
+            steps.append(step_report)
+            every_stats.extend(stats_list)
+        report["steps"] = steps
+        overall = summarize(
+            every_stats, sum(s["duration_sec"] for s in steps) or 1e-9,
+            samples, top_slow,
+        )
+        report.update(overall)
+        all_slowest = overall["slowest"]
+    else:
+        stats_list, wall = run_closed(
+            send, users, duration, warmup, expected_interval_s, top_slow
+        )
+        if expected_interval_s:
+            report["expected_interval_ms"] = expected_interval_ms
+        report.update(summarize(stats_list, wall, samples, top_slow))
+        all_slowest = report["slowest"]
+
+    if flight and _send is None:
+        report["flight"] = fetch_worst_traces(host, all_slowest)
+    return report
 
 
 def main(argv=None) -> int:
@@ -68,9 +445,32 @@ def main(argv=None) -> int:
     parser.add_argument("--host", required=True)
     parser.add_argument("--project", required=True)
     parser.add_argument("--machine")
+    parser.add_argument(
+        "--mode", choices=("closed", "qps", "ramp"), default="closed"
+    )
     parser.add_argument("--users", type=int, default=8)
-    parser.add_argument("--duration", type=float, default=30.0)
+    parser.add_argument("--duration", type=float, default=30.0,
+                        help="measure window seconds (per step in ramp mode)")
+    parser.add_argument("--warmup", type=float, default=0.0,
+                        help="seconds of traffic excluded from measurement "
+                        "(per step in ramp mode)")
+    parser.add_argument("--qps", type=float, default=None,
+                        help="open-loop request rate for --mode qps")
+    parser.add_argument(
+        "--ramp-users", default="1,2,4,8",
+        help="comma-separated concurrency steps for --mode ramp",
+    )
     parser.add_argument("--samples", type=int, default=100)
+    parser.add_argument(
+        "--expected-interval-ms", type=float, default=None,
+        help="closed-loop coordinated-omission correction: back-fill "
+        "latencies as if a request had been due every this-many ms",
+    )
+    parser.add_argument("--top-slow", type=int, default=DEFAULT_TOP_SLOW)
+    parser.add_argument(
+        "--no-flight", action="store_true",
+        help="skip the /debug/flight worst-request cross-check",
+    )
     parser.add_argument(
         "--codec",
         choices=("fast", "pandas"),
@@ -81,64 +481,25 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    machine, tags = discover(args.host, args.project, args.machine)
-    import random
-
-    X = [[random.random() for _ in tags] for _ in range(args.samples)]
-    body = json.dumps({"X": X, "y": X}).encode()
-    url = f"{args.host}/gordo/v0/{args.project}/{machine}/anomaly/prediction"
-    headers = {"Content-Type": "application/json"}
-    if args.codec:
-        headers["X-Gordo-Codec"] = args.codec
-
-    # warmup one request so compile/model-load cost isn't in the measurement
     try:
-        req = urllib.request.Request(url, data=body, headers=headers)
-        urllib.request.urlopen(req, timeout=120).read()
-    except Exception as exc:  # noqa: BLE001
-        print(json.dumps({"error": f"warmup request failed: {exc!r}"}))
+        ramp_users = [
+            int(u) for u in str(args.ramp_users).split(",") if u.strip()
+        ]
+    except ValueError:
+        print(json.dumps({"error": f"bad --ramp-users {args.ramp_users!r}"}))
         return 1
-
-    times: list = []
-    errors: list = []
-    stop_at = time.monotonic() + args.duration
-    threads = [
-        threading.Thread(
-            target=worker,
-            args=(url, body, stop_at, times, errors, headers),
-            daemon=True,
-        )
-        for _ in range(args.users)
-    ]
-    wall_start = time.monotonic()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.monotonic() - wall_start
-
-    if not times:
-        print(json.dumps({"error": "no successful requests", "errors": errors[:5]}))
-        return 1
-    times.sort()
-    print(
-        json.dumps(
-            {
-                "machine": machine,
-                "codec": args.codec or "default",
-                "users": args.users,
-                "duration_sec": round(wall, 2),
-                "requests": len(times),
-                "errors": len(errors),
-                "req_per_sec": round(len(times) / wall, 2),
-                "samples_per_sec": round(len(times) * args.samples / wall, 1),
-                "p50_ms": round(times[len(times) // 2] * 1e3, 2),
-                "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 2),
-                "mean_ms": round(statistics.fmean(times) * 1e3, 2),
-            }
-        )
+    report = run(
+        host=args.host, project=args.project, machine=args.machine,
+        mode=args.mode, users=args.users, duration=args.duration,
+        warmup=args.warmup, qps=args.qps, ramp_users=ramp_users,
+        samples=args.samples, codec=args.codec,
+        expected_interval_ms=args.expected_interval_ms,
+        flight=not args.no_flight, top_slow=args.top_slow,
     )
-    return 0
+    print(json.dumps(report))
+    if "error" in report:
+        return 1
+    return 0 if report.get("requests") else 1
 
 
 if __name__ == "__main__":

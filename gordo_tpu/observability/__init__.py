@@ -1,3 +1,4 @@
+from gordo_tpu.observability import latency  # noqa: F401
 from gordo_tpu.observability import telemetry  # noqa: F401
 from gordo_tpu.observability import tracing  # noqa: F401
 from gordo_tpu.observability.grafana import (  # noqa: F401

@@ -90,9 +90,14 @@ def adapt_proxy_deployment(wsgi_app):
         ).split("?", 1)[0]
         if original:
             local = path_info.rstrip("/")
-            if local and original.endswith(local):
+            # match against the rstripped original too: '/svc/metadata/'
+            # must derive the same prefix as '/svc/metadata' — otherwise a
+            # trailing-slash request turns the WHOLE original path into
+            # SCRIPT_NAME and corrupts generated URLs (round-5 advisor)
+            stripped = original.rstrip("/")
+            if local and stripped.endswith(local):
                 # the prefix is the full original path minus the local path
-                prefix = original[: -len(local)]
+                prefix = stripped[: -len(local)]
             else:
                 # header names the prefix itself (or PATH_INFO already IS
                 # the full external path, which _localize then strips)

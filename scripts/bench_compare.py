@@ -6,8 +6,8 @@ The repo accumulates one bench record per round (r01..r05 so far); until
 now the trajectory was eyeball-only. This script turns any pair into a
 checkable gate: ``python scripts/bench_compare.py BENCH_r04.json
 BENCH_r05.json`` exits non-zero when a headline metric regressed past
-the threshold, so CI (or a release script) can refuse a round that got
-slower.
+the threshold, so CI (or ``make bench-gate``) can refuse a round that
+got slower. ``--latest [DIR]`` picks the two most recent records itself.
 
 Compared metrics, read from each record's ``parsed`` block (the final
 summary line bench.py always emits, budget trips included):
@@ -16,6 +16,17 @@ summary line bench.py always emits, budget trips included):
 - ``server_samples_per_sec`` — serving throughput (higher is better)
 - ``server_p50_net_of_floor_ms`` — serving p50 net of the device
   round-trip floor (lower is better)
+- ``server_load_req_per_sec`` / ``server_load_p99_ms`` — the open-loop
+  load section's sustained rate and coordinated-omission-safe tail
+
+**Comparable-section matching** (schema v2): every metric is fed by one
+harness section (``value`` by ``headline``, the ``server_*`` trio by the
+record's ``serving_source``, ``server_load_*`` by ``serving_load``). A
+metric only participates when its feeding section completed in BOTH
+records — a section that timed out, failed, or was skipped for budget
+yields partial or missing numbers that must read as "not comparable",
+never as a regression or an improvement. Legacy (pre-schema) records
+have no section accounting and compare on raw presence, as before.
 
 Missing metrics are skipped with a note (old records predate some
 fields). Records from different platforms (cpu vs tpu) are not
@@ -26,11 +37,14 @@ makes that an error: a CI runner falling back to CPU must not read as a
 Exit codes: 0 = no regression (or not comparable), 1 = regression past
 ``--threshold`` (default 0.15 = 15%), 2 = a record is unusable (missing
 / unparseable / no ``parsed`` block). Wired into tier-1 by
-tests/gordo_tpu/test_benchmarks.py.
+tests/gordo_tpu/test_benchmarks.py; ``make bench-gate`` runs the latest
+pair.
 """
 
 import argparse
+import glob
 import json
+import os
 import sys
 from typing import List, Optional, Tuple
 
@@ -39,7 +53,38 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     ("value", True),
     ("server_samples_per_sec", True),
     ("server_p50_net_of_floor_ms", False),
+    ("server_load_req_per_sec", True),
+    ("server_load_p99_ms", False),
 )
+
+# which harness section feeds each metric (schema v2 records carry a
+# per-section status map; see bench.py SECTION_NAMES/SECTION_STATUSES)
+_SERVING_METRICS = frozenset(
+    {"server_samples_per_sec", "server_p50_anomaly_ms",
+     "server_p50_net_of_floor_ms", "server_d2h_floor_ms"}
+)
+
+
+def metric_section(key: str, parsed: dict) -> Optional[str]:
+    if key in ("value", "vs_baseline", "mfu"):
+        return "headline"
+    if key in _SERVING_METRICS:
+        return parsed.get("serving_source")
+    if key.startswith("server_load_"):
+        return "serving_load"
+    return None
+
+
+def section_status(parsed: dict, name: Optional[str]) -> Optional[str]:
+    """The status of section ``name`` in a record, or None when the record
+    predates section accounting (legacy: compare on raw presence)."""
+    sections = parsed.get("sections")
+    if not isinstance(sections, dict) or name is None:
+        return None
+    entry = sections.get(name)
+    if isinstance(entry, dict):  # detail-style entries
+        return entry.get("status")
+    return entry
 
 
 def load_parsed(path: str) -> Optional[dict]:
@@ -68,6 +113,21 @@ def compare(
     regressions: List[str] = []
     lines: List[str] = []
     for key, higher_better in METRICS:
+        # comparable-section matching: the feeding section must have
+        # COMPLETED in both records for this metric to participate
+        not_comparable = None
+        for label, record in (("old", old), ("new", new)):
+            section = metric_section(key, record)
+            status = section_status(record, section)
+            if status is not None and status != "completed":
+                not_comparable = (
+                    f"{key}: skipped (section {section} is "
+                    f"'{status}' in {label} record)"
+                )
+                break
+        if not_comparable:
+            lines.append(not_comparable)
+            continue
         old_value, new_value = old.get(key), new.get(key)
         if not isinstance(old_value, (int, float)) or not isinstance(
             new_value, (int, float)
@@ -95,10 +155,21 @@ def compare(
     return regressions, lines
 
 
+def latest_records(directory: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
+
+
 def main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("old", help="baseline BENCH_r*.json")
-    parser.add_argument("new", help="candidate BENCH_r*.json")
+    parser.add_argument("old", nargs="?", help="baseline BENCH_r*.json")
+    parser.add_argument("new", nargs="?", help="candidate BENCH_r*.json")
+    parser.add_argument(
+        "--latest",
+        metavar="DIR",
+        help="ignore positional args and compare the two most recent "
+        "BENCH_r*.json under DIR (exit 0 with a note when fewer than "
+        "two exist)",
+    )
     parser.add_argument(
         "--threshold",
         type=float,
@@ -114,10 +185,31 @@ def main(argv: List[str]) -> int:
     )
     args = parser.parse_args(argv)
 
-    old = load_parsed(args.old)
-    new = load_parsed(args.new)
-    if old is None or new is None:
-        return 2
+    if args.latest:
+        # gate on the two most recent USABLE records: unusable ones (the
+        # pre-schema data-loss rounds) carry no baseline worth refusing a
+        # release over, and schema conformance is lint_bench_record.py's
+        # job, not this gate's
+        usable = [
+            (path, parsed)
+            for path in latest_records(args.latest)
+            if (parsed := load_parsed(path)) is not None
+        ]
+        if len(usable) < 2:
+            print(
+                f"bench-gate: fewer than two usable BENCH_r*.json records "
+                f"under {args.latest!r} ({len(usable)} found); nothing to "
+                f"compare"
+            )
+            return 0
+        (args.old, old), (args.new, new) = usable[-2], usable[-1]
+    else:
+        if not args.old or not args.new:
+            parser.error("need OLD and NEW records (or --latest DIR)")
+        old = load_parsed(args.old)
+        new = load_parsed(args.new)
+        if old is None or new is None:
+            return 2
 
     old_platform = old.get("platform") or "?"
     new_platform = new.get("platform") or "?"

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""
+Lint: every ``BENCH_r*.json`` record conforms to the harness record schema.
+
+The round-4/5 postmortems were both "the bench ran, the record is
+useless" failures (rc=124, ``parsed: null``, sections silently missing).
+The schema-v2 harness (bench.py) promises a final summary line where
+**every canonical section is present with an explicit status** — this
+lint makes that promise checkable on the artifacts themselves, the same
+enforcement pattern as the bare-except / metric-name / env-knob lints.
+
+Checked per record (a driver-written JSON with a ``parsed`` block):
+
+- the record parses and carries a ``parsed`` summary dict;
+- schema-v2 summaries (``schema_version`` >= 2) must have a
+  ``sections`` map covering every canonical section name with a status
+  from the known vocabulary, and numeric-or-null summary metrics;
+- records written before the schema (r01–r05) have no ``schema_version``
+  and are reported as ``legacy`` — skipped unless ``--strict``, which
+  turns them (and any ``parsed: null`` data-loss record) into failures.
+
+Usage: ``python scripts/lint_bench_record.py [--strict] [files...]``
+(default: every ``BENCH_r*.json`` at the repo root). Exit 0 = all
+records valid or legacy, 1 = violations (one per line). Wired into
+tier-1 via tests/gordo_tpu/test_lint.py.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# summary keys that must be number-or-null when present
+_NUMERIC_KEYS = (
+    "value", "vs_baseline", "mfu",
+    "server_samples_per_sec", "server_p50_anomaly_ms",
+    "server_d2h_floor_ms", "server_p50_net_of_floor_ms",
+    "server_load_req_per_sec", "server_load_p50_ms",
+    "server_load_p99_ms", "server_load_p999_ms",
+)
+
+
+def _section_contract() -> Tuple[List[str], List[str]]:
+    """Canonical section names/statuses from bench.py itself (single
+    source of truth), with a frozen fallback when bench.py is absent
+    (running the script from an sdist without the harness)."""
+    try:
+        sys.path.insert(0, REPO_ROOT)
+        import bench
+
+        return list(bench.SECTION_NAMES), list(bench.SECTION_STATUSES)
+    except Exception:  # noqa: BLE001 — the lint must run without the harness
+        return (
+            ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"],
+            ["completed", "skipped_for_budget", "failed", "timeout",
+             "disabled"],
+        )
+
+
+def validate_record(path: str, strict: bool = False) -> List[str]:
+    """Violations for one record file ([] = valid or legacy-skipped)."""
+    names, statuses = _section_contract()
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable record: {exc}"]
+    if not isinstance(record, dict):
+        return [f"{path}: record is not a JSON object"]
+
+    parsed = record.get("parsed")
+    if not isinstance(parsed, dict) or "schema_version" not in parsed:
+        if strict:
+            return [
+                f"{path}: legacy/pre-schema record (no parsed "
+                f"schema_version) rejected by --strict"
+            ]
+        print(f"{path}: legacy (pre-schema) record — skipped")
+        return []
+
+    violations: List[str] = []
+    sections = parsed.get("sections")
+    if not isinstance(sections, dict):
+        violations.append(f"{path}: parsed.sections missing or not a map")
+    else:
+        for name in names:
+            if name not in sections:
+                violations.append(
+                    f"{path}: section {name!r} unaccounted for in "
+                    f"parsed.sections"
+                )
+        for name, status in sections.items():
+            if isinstance(status, dict):  # detail-style entry
+                status = status.get("status")
+            if status not in statuses:
+                violations.append(
+                    f"{path}: section {name!r} has status {status!r} "
+                    f"(must be one of {statuses})"
+                )
+    for key in ("metric", "unit", "platform"):
+        if not isinstance(parsed.get(key), str):
+            violations.append(f"{path}: parsed.{key} missing or not a string")
+    for key in _NUMERIC_KEYS:
+        value = parsed.get(key, None)
+        if value is not None and not isinstance(value, (int, float)):
+            violations.append(
+                f"{path}: parsed.{key} is {type(value).__name__}, "
+                f"expected number or null"
+            )
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "files", nargs="*",
+        help="records to validate (default: BENCH_r*.json at repo root)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="reject legacy/pre-schema records instead of skipping them",
+    )
+    args = parser.parse_args(argv)
+
+    files = args.files or sorted(
+        glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json"))
+    )
+    if not files:
+        print("no BENCH_r*.json records to lint")
+        return 0
+    violations: List[str] = []
+    for path in files:
+        violations.extend(validate_record(path, strict=args.strict))
+    for line in violations:
+        print(line)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

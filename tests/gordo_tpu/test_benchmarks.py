@@ -123,10 +123,15 @@ def test_bench_budget_skips_sections_but_always_emits_record(
     bench.main()
     assert started == []  # zero budget: no child ever launched
     record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert set(record["skipped_for_budget"]) == {
-        "tpu_smoke", "headline", "windowed", "batch_ab",
-    }
+    assert set(record["skipped_for_budget"]) == set(bench.SECTION_NAMES)
     assert record["value"] is None
+    # schema v2: every canonical section accounted for with a status
+    assert record["schema_version"] == bench.RECORD_SCHEMA_VERSION
+    assert set(record["sections"]) == set(bench.SECTION_NAMES)
+    assert all(
+        status == "skipped_for_budget"
+        for status in record["sections"].values()
+    )
 
 
 def test_bench_backend_probe_require_accel(monkeypatch):
@@ -239,6 +244,267 @@ def test_bench_section_crash_partial_recovery(monkeypatch):
     assert "error" in entry
 
 
+def test_bench_run_section_status_vocabulary(monkeypatch):
+    """Every _run_section exit path stamps an explicit schema-v2 status."""
+    import subprocess
+
+    import bench
+
+    class Good:
+        returncode = 0
+        stdout = json.dumps({"platform": "cpu", "result": {"x": 1}}) + "\n"
+        stderr = ""
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: Good())
+    entry = bench._run_section("windowed", timeout=7)
+    assert entry["status"] == "completed"
+    assert entry["timeout_s"] == 7 and "wall_sec" in entry
+
+    def hang(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=7, output=b"")
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    assert bench._run_section("windowed", timeout=7)["status"] == "timeout"
+
+    class Crash:
+        returncode = 1
+        stdout = ""
+        stderr = "boom"
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: Crash())
+    assert bench._run_section("windowed", timeout=7)["status"] == "failed"
+
+    class Garbage:
+        returncode = 0
+        stdout = "not json"
+        stderr = ""
+
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: Garbage())
+    assert bench._run_section("windowed", timeout=7)["status"] == "failed"
+
+
+def test_degraded_sections_include_budget_skips():
+    """Round-5 advisor finding (bench.py recovery pass): budget-skipped
+    sections join the recovery pass — the per-rerun remaining-wall check
+    still guards the deadline — and a completed rerun (even CPU) replaces
+    a skip entry, but never a completed measurement."""
+    import bench
+
+    sections = {
+        "headline": {"status": "skipped_for_budget",
+                     "skipped_for_budget": True, "remaining_sec": 400},
+        "windowed": {"platform": "tpu", "result": {}, "status": "completed"},
+    }
+    assert bench._degraded_sections(sections) == ["headline"]
+    cpu_ok = {"platform": "cpu", "result": {"machines_per_min": 1}}
+    assert bench._rerun_improves(cpu_ok, sections["headline"])
+    assert not bench._rerun_improves(cpu_ok, cpu_ok)
+
+
+def test_bench_tiny_budget_subprocess_emits_complete_record(tmp_path):
+    """Acceptance: a REAL ``python bench.py`` run under
+    GORDO_TPU_BENCH_BUDGET_S exits rc=0 with a parseable final record in
+    which every canonical section is present with an explicit status —
+    the rc=124 total-data-loss mode is structurally gone."""
+    import subprocess
+
+    import bench
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = {
+        **os.environ,
+        "GORDO_TPU_BENCH_BUDGET_S": "1",
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_DETAIL_FILE": str(tmp_path / "detail.json"),
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["schema_version"] == bench.RECORD_SCHEMA_VERSION
+    assert set(record["sections"]) == set(bench.SECTION_NAMES)
+    assert all(
+        status in bench.SECTION_STATUSES
+        for status in record["sections"].values()
+    )
+    assert set(record["skipped_for_budget"]) == set(bench.SECTION_NAMES)
+    # the detail record carries the same accounting
+    detail = json.loads((tmp_path / "detail.json").read_text())
+    assert set(detail["sections"]) == set(bench.SECTION_NAMES)
+
+
+def test_bench_section_selector_env(capsys, monkeypatch, tmp_path):
+    """GORDO_TPU_BENCH_SECTIONS selects sections; the others are recorded
+    as disabled, never silently dropped."""
+    import bench
+
+    monkeypatch.setenv("GORDO_TPU_BENCH_SECTIONS", "tpu_smoke,serving_load")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("GORDO_TPU_BENCH_BUDGET_S", "0")  # skip instantly
+    monkeypatch.setenv("BENCH_DETAIL_FILE", str(tmp_path / "detail.json"))
+    monkeypatch.setattr(bench, "_run_section", lambda *a, **k: {})
+    bench.main()
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["sections"]["tpu_smoke"] == "skipped_for_budget"
+    assert record["sections"]["serving_load"] == "skipped_for_budget"
+    assert record["sections"]["headline"] == "disabled"
+    assert record["sections"]["windowed"] == "disabled"
+    assert record["sections"]["batch_ab"] == "disabled"
+
+
+# ------------------------------------------------ load generator (rewrite)
+def test_load_test_qps_mode_live_server(live_server, gordo_project, capsys):
+    """Open-loop QPS mode end-to-end: merged histogram percentiles
+    (p50/p90/p99/p99.9), Server-Timing-fed phase histograms, trace ids."""
+    rc = load_test.main(
+        [
+            "--host", live_server, "--project", gordo_project,
+            "--mode", "qps", "--qps", "20", "--duration", "2",
+            "--warmup", "0.5", "--users", "4", "--samples", "5",
+            "--no-flight",
+        ]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["mode"] == "qps" and report["qps_target"] == 20.0
+    assert report["requests"] > 0 and report["errors"] == 0
+    for key in ("p50_ms", "p90_ms", "p95_ms", "p99_ms", "p999_ms"):
+        assert isinstance(report[key], float), key
+    assert report["p999_ms"] >= report["p50_ms"]
+    assert 0 < report["latency_rel_error_bound"] < 0.02
+    # per-phase histograms fed from the Server-Timing header (PR 2)
+    assert "request_walltime" in report["phases"]
+    assert report["phases"]["request_walltime"]["p99_ms"] > 0
+    # slowest requests carry trace ids for the flight cross-check
+    assert report["slowest"] and report["slowest"][0]["trace_id"]
+
+
+def test_load_test_ramp_mode(live_server, gordo_project, capsys):
+    rc = load_test.main(
+        [
+            "--host", live_server, "--project", gordo_project,
+            "--mode", "ramp", "--ramp-users", "1,2", "--duration", "1",
+            "--samples", "5", "--no-flight",
+        ]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [step["users"] for step in report["steps"]] == [1, 2]
+    assert all(step["requests"] > 0 for step in report["steps"])
+    assert report["requests"] == sum(s["requests"] for s in report["steps"])
+
+
+def test_load_test_open_loop_surfaces_stall_in_tail():
+    """Coordinated omission: one 0.6s server stall at 25 QPS. Open-loop
+    accounting measures every queued request from its INTENDED send time,
+    so the backlog the stall created lands in the tail — p99 must report
+    hundreds of ms while p50 stays fast. (A naive closed-loop would have
+    recorded one slow sample and ~fast everything else.)"""
+    import threading as _threading
+    import time as _time
+
+    from benchmarks.load_test import run_open, summarize
+
+    calls = [0]
+    lock = _threading.Lock()
+
+    def send():
+        with lock:
+            calls[0] += 1
+            n = calls[0]
+        _time.sleep(0.6 if n == 10 else 0.002)
+        return None, None, {}
+
+    stats, wall = run_open(send, users=1, qps=25, duration=2.0, warmup=0.0)
+    report = summarize(stats, wall, 1)
+    assert report["requests"] >= 40
+    assert report["p99_ms"] > 200, report
+    assert report["p50_ms"] < 100, report
+
+
+def test_load_test_flight_cross_check(live_server, gordo_project,
+                                      monkeypatch, capsys):
+    """The closing argument: the report's worst requests come back with
+    their span trees pulled from the PR-5 flight recorder."""
+    from gordo_tpu.observability import flight
+
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    # keep every trace: a tiny threshold + a ring big enough that the
+    # slowest requests can't be evicted before the final fetch
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.0001")
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_CAPACITY", "4096")
+    flight.reset()
+    try:
+        rc = load_test.main(
+            [
+                "--host", live_server, "--project", gordo_project,
+                "--duration", "1", "--users", "2", "--samples", "5",
+            ]
+        )
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        worst = report["flight"]
+        assert worst["available"] is True
+        assert worst["recorded"] >= 1
+        recorded = [w for w in worst["worst_requests"] if w["recorded"]]
+        assert recorded and recorded[0]["trace_id"]
+        span_names = {
+            span["name"] for w in recorded for span in w["spans"]
+        }
+        assert "serve_request" in span_names
+    finally:
+        flight.reset()
+
+
+def test_load_test_flight_gated_off_degrades(live_server, gordo_project,
+                                             capsys):
+    """Without GORDO_TPU_DEBUG_ENDPOINTS the cross-check degrades to a
+    reason string, never an error."""
+    rc = load_test.main(
+        [
+            "--host", live_server, "--project", gordo_project,
+            "--duration", "1", "--users", "2", "--samples", "5",
+        ]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["flight"]["available"] is False
+    assert "GORDO_TPU_DEBUG_ENDPOINTS" in report["flight"]["reason"]
+
+
+def test_bench_serving_load_section(monkeypatch):
+    """The bench harness's serving_load section end-to-end (tiny knobs):
+    builds a model, serves it over real HTTP, drives the open-loop load
+    generator, and returns QPS + ramp reports with tail percentiles and
+    flight-recorded worst requests."""
+    import bench
+    from gordo_tpu.observability import flight
+
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.0001")
+    monkeypatch.setenv("GORDO_TPU_FLIGHT_CAPACITY", "4096")
+    monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_QPS", "20")
+    monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_SECONDS", "1.5")
+    monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_WARMUP_S", "0.3")
+    monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_USERS", "2")
+    monkeypatch.setattr(bench, "EPOCHS", 1)  # one-epoch model build
+    flight.reset()
+    try:
+        result = bench._bench_serving_load()
+    finally:
+        flight.reset()
+    qps = result["qps"]
+    assert qps["requests"] > 0 and qps["mode"] == "qps"
+    assert qps["p999_ms"] >= qps["p50_ms"] > 0
+    assert qps["flight"]["available"] is True
+    assert [s["users"] for s in result["ramp"]["steps"]] == [1, 2, 4]
+
+
 # ------------------------------------------------------- bench_compare gate
 def _run_compare(*args):
     import subprocess
@@ -305,6 +571,76 @@ def test_bench_compare_unusable_record(tmp_path):
     junk.write_text("{}")  # no parsed block
     assert _run_compare(old, junk).returncode == 2
     assert _run_compare(tmp_path / "missing.json", old).returncode == 2
+
+
+def _v2_record(tmp_path, name, statuses=None, **parsed):
+    """A schema-v2 record: full section accounting + summary keys."""
+    import bench
+
+    sections = {n: "completed" for n in bench.SECTION_NAMES}
+    sections.update(statuses or {})
+    base = {
+        "schema_version": bench.RECORD_SCHEMA_VERSION,
+        "platform": "cpu",
+        "serving_source": "headline",
+        "sections": sections,
+    }
+    base.update(parsed)
+    path = tmp_path / name
+    path.write_text(json.dumps({"n": 1, "parsed": base}))
+    return path
+
+
+def test_bench_compare_section_matching_excludes_incomplete(tmp_path):
+    """Comparable-section matching: a metric whose feeding section did
+    not complete in one record is 'not comparable', never a regression —
+    a timed-out headline must not read as a 90% slowdown."""
+    old = _v2_record(tmp_path, "old.json", value=100.0,
+                     server_load_p99_ms=10.0)
+    # headline timed out in the new record; its partial value would
+    # otherwise read as a catastrophic regression
+    new = _v2_record(tmp_path, "new.json", value=9.0,
+                     server_load_p99_ms=10.5,
+                     statuses={"headline": "timeout"})
+    result = _run_compare(old, new)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "value: skipped (section headline is 'timeout'" in result.stdout
+
+
+def test_bench_compare_gates_on_load_tail_regression(tmp_path):
+    """The new serving_load metrics are first-class gate inputs: a
+    doubled open-loop p99 or halved sustained rate trips the gate."""
+    old = _v2_record(tmp_path, "old.json", value=100.0,
+                     server_load_p99_ms=10.0, server_load_req_per_sec=50.0)
+    new = _v2_record(tmp_path, "new.json", value=101.0,
+                     server_load_p99_ms=20.0, server_load_req_per_sec=48.0)
+    result = _run_compare(old, new)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "server_load_p99_ms" in result.stdout
+    # but not when the serving_load section was budget-skipped
+    skipped = _v2_record(
+        tmp_path, "skipped.json", value=101.0, server_load_p99_ms=None,
+        statuses={"serving_load": "skipped_for_budget"},
+    )
+    assert _run_compare(old, skipped).returncode == 0
+
+
+def test_bench_compare_latest_mode(tmp_path):
+    """--latest picks the two most recent records; fewer than two is a
+    note, not an error (first round of a fresh repo)."""
+    assert _run_compare("--latest", tmp_path).returncode == 0
+    _v2_record(tmp_path, "BENCH_r01.json", value=100.0)
+    _v2_record(tmp_path, "BENCH_r02.json", value=99.0)
+    _v2_record(tmp_path, "BENCH_r03.json", value=50.0)  # regressed vs r02
+    # a newer DATA-LOSS record (parsed: null, the r04 failure shape) is
+    # skipped — the gate compares the most recent USABLE pair
+    (tmp_path / "BENCH_r04.json").write_text(
+        json.dumps({"n": 4, "rc": 124, "parsed": None})
+    )
+    result = _run_compare("--latest", tmp_path)
+    assert result.returncode == 1
+    assert "BENCH_r02.json" in result.stdout
+    assert "BENCH_r03.json" in result.stdout
 
 
 def test_bench_compare_smoke_on_checked_in_records():

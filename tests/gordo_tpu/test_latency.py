@@ -1,0 +1,196 @@
+"""
+The log-bucketed latency histogram (observability/latency.py): the load
+harness's percentile math must itself be trustworthy — merge associativity,
+quantile accuracy against a sorted-array reference within the documented
+error bound, thread-safety, serialization, and the coordinated-omission
+correction (a stalled server must inflate p99, never hide it).
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from gordo_tpu.observability.latency import (
+    DEFAULT_SUBBUCKETS,
+    LatencyHistogram,
+)
+
+
+def _reference_quantile(values, q):
+    """Nearest-rank quantile over the retained samples."""
+    import math
+
+    ordered = sorted(values)
+    if q <= 0:
+        return ordered[0]
+    if q >= 1:
+        return ordered[-1]
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[rank - 1]
+
+
+@pytest.mark.parametrize("distribution", ["uniform", "lognormal", "bimodal"])
+def test_quantiles_match_sorted_reference_within_error_bound(distribution):
+    rng = random.Random(7)
+    if distribution == "uniform":
+        values = [rng.uniform(1e-4, 2.0) for _ in range(20_000)]
+    elif distribution == "lognormal":
+        values = [rng.lognormvariate(-5.0, 1.5) for _ in range(20_000)]
+    else:
+        values = [
+            rng.uniform(0.001, 0.002) if rng.random() < 0.99
+            else rng.uniform(1.0, 2.0)
+            for _ in range(20_000)
+        ]
+    hist = LatencyHistogram()
+    for value in values:
+        hist.record(value)
+    assert hist.count == len(values)
+    for q in (0.5, 0.9, 0.99, 0.999):
+        got = hist.quantile(q)
+        want = _reference_quantile(values, q)
+        # bucket midpoints are exact to rel_error_bound; rank-vs-bucket
+        # boundary effects at repeated values allow one extra bucket width
+        tolerance = want * (4.0 / DEFAULT_SUBBUCKETS)
+        assert abs(got - want) <= tolerance, (q, got, want)
+
+
+def test_exact_min_max_and_edge_quantiles():
+    hist = LatencyHistogram()
+    for value in (0.010, 0.020, 0.500):
+        hist.record(value)
+    assert hist.quantile(0.0) == pytest.approx(0.010)
+    assert hist.quantile(1.0) == pytest.approx(0.500)
+    summary = hist.summary()
+    assert summary["count"] == 3
+    assert summary["min_s"] == pytest.approx(0.010)
+    assert summary["max_s"] == pytest.approx(0.500)
+    assert summary["mean_s"] == pytest.approx((0.01 + 0.02 + 0.5) / 3)
+    assert set(summary) >= {"p50_s", "p90_s", "p99_s", "p99.9_s"}
+
+
+def test_empty_histogram_reports_none():
+    hist = LatencyHistogram()
+    assert hist.quantile(0.5) is None
+    assert hist.summary()["p99_s"] is None
+    assert hist.summary()["count"] == 0
+
+
+def test_bad_values_clamped_not_raised():
+    hist = LatencyHistogram()
+    hist.record(0.0)
+    hist.record(-5.0)
+    hist.record(float("nan"))
+    hist.record(float("inf"))
+    assert hist.count == 4
+    assert hist.quantile(1.0) <= 1e9
+
+
+def test_merge_associative_and_commutative():
+    rng = random.Random(3)
+    shards = [
+        [rng.lognormvariate(-4.0, 1.0) for _ in range(2_000)]
+        for _ in range(3)
+    ]
+
+    def hist_of(values):
+        h = LatencyHistogram()
+        for v in values:
+            h.record(v)
+        return h
+
+    a, b, c = (hist_of(s) for s in shards)
+    left = LatencyHistogram().merge(a).merge(b).merge(c)
+    bc = LatencyHistogram().merge(b).merge(c)
+    right = LatencyHistogram().merge(a).merge(bc)
+    reversed_order = LatencyHistogram.merged([c, b, a])
+    flat = hist_of([v for s in shards for v in s])
+    for q in (0.5, 0.9, 0.99, 0.999):
+        assert left.quantile(q) == right.quantile(q) == \
+            reversed_order.quantile(q) == flat.quantile(q)
+    assert left.count == right.count == flat.count == 6_000
+    assert left.to_dict()["buckets"] == flat.to_dict()["buckets"]
+
+
+def test_merge_rejects_mismatched_subbuckets():
+    with pytest.raises(ValueError):
+        LatencyHistogram(64).merge(LatencyHistogram(32))
+
+
+def test_thread_safety_shared_instance():
+    """8 writers into ONE shared histogram: no lost updates."""
+    hist = LatencyHistogram()
+    per_thread = 5_000
+    rng_seed = [11, 22, 33, 44, 55, 66, 77, 88]
+
+    def write(seed):
+        rng = random.Random(seed)
+        for _ in range(per_thread):
+            hist.record(rng.uniform(0.001, 0.1))
+
+    threads = [threading.Thread(target=write, args=(s,)) for s in rng_seed]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert hist.count == per_thread * len(threads)
+    assert sum(hist.to_dict()["buckets"].values()) == hist.count
+
+
+def test_per_thread_histograms_merge_equals_shared():
+    """The recommended hot-path pattern: per-worker histograms merged
+    afterwards must agree with a single shared histogram."""
+    values = [random.Random(9).uniform(1e-3, 1.0) for _ in range(9_000)]
+    shared = LatencyHistogram()
+    workers = [LatencyHistogram() for _ in range(3)]
+    for i, value in enumerate(values):
+        shared.record(value)
+        workers[i % 3].record(value)
+    merged = LatencyHistogram.merged(workers)
+    assert merged.to_dict()["buckets"] == shared.to_dict()["buckets"]
+    assert merged.quantile(0.999) == shared.quantile(0.999)
+
+
+def test_serialization_roundtrip_through_json():
+    hist = LatencyHistogram()
+    rng = random.Random(5)
+    for _ in range(1_000):
+        hist.record(rng.lognormvariate(-3.0, 1.0))
+    payload = json.loads(json.dumps(hist.to_dict()))
+    restored = LatencyHistogram.from_dict(payload)
+    assert restored.count == hist.count
+    for q in (0.5, 0.99, 0.999):
+        assert restored.quantile(q) == hist.quantile(q)
+    # a restored histogram keeps merging (the bench parent's use case)
+    restored.merge(hist)
+    assert restored.count == 2 * hist.count
+
+
+def test_coordinated_omission_correction_inflates_p99():
+    """Closed-loop accounting: 1000 requests at 1ms, then ONE 2-second
+    stall. Uncorrected, the stall is a single outlier and p99 stays ~1ms —
+    the lie coordinated omission tells. With the expected-interval
+    correction the back-filled samples surface the stall in p99."""
+    interval = 0.001
+    uncorrected = LatencyHistogram()
+    corrected = LatencyHistogram()
+    for _ in range(100_000):
+        uncorrected.record(interval)
+        corrected.record_with_expected_interval(interval, interval)
+    uncorrected.record(2.0)
+    corrected.record_with_expected_interval(2.0, interval)
+
+    assert uncorrected.quantile(0.99) < 0.002  # the stall is hidden
+    # corrected: ~2000 back-filled samples spanning (0, 2s] join 100k good
+    # ones — p99 must now report ~1s of queueing, while p50 stays ~1ms
+    assert corrected.quantile(0.99) > 0.5
+    assert corrected.quantile(0.5) < 0.01
+
+
+def test_expected_interval_noop_without_interval():
+    hist = LatencyHistogram()
+    hist.record_with_expected_interval(1.0, None)
+    hist.record_with_expected_interval(1.0, 0.0)
+    assert hist.count == 2

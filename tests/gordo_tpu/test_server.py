@@ -654,6 +654,48 @@ def test_proxy_envoy_prefix_suffix_strip_not_substring():
     assert seen["PATH_INFO"] == "/metrics"
 
 
+def test_proxy_envoy_trailing_slash_same_prefix():
+    """A trailing-slash request must derive the SAME prefix as its
+    slashless sibling: for PATH_INFO '/metadata/' with original
+    '/svc/metadata/', SCRIPT_NAME is '/svc' — not the whole original
+    path (which would corrupt every generated URL)."""
+    from gordo_tpu.server.server import adapt_proxy_deployment
+
+    seen = {}
+
+    def inner(environ, start_response):
+        seen.update(environ)
+        return []
+
+    wrapped = adapt_proxy_deployment(inner)
+    environ = {
+        "PATH_INFO": "/metadata/",
+        "HTTP_X_ENVOY_ORIGINAL_PATH": "/svc/metadata/",
+    }
+    wrapped(environ, lambda *a: None)
+    assert seen["SCRIPT_NAME"] == "/svc"
+    assert seen["PATH_INFO"] == "/metadata/"  # routing path untouched
+
+    # and the slashless sibling agrees
+    seen.clear()
+    environ = {
+        "PATH_INFO": "/metadata",
+        "HTTP_X_ENVOY_ORIGINAL_PATH": "/svc/metadata",
+    }
+    wrapped(environ, lambda *a: None)
+    assert seen["SCRIPT_NAME"] == "/svc"
+
+
+def test_proxy_envoy_trailing_slash_routes(client):
+    """End-to-end: a trailing-slash healthcheck behind a stripped prefix
+    still routes (strict_slashes off) with the right prefix derivation."""
+    resp = client.get(
+        "/healthcheck/",
+        headers={"X-Envoy-Original-Path": "/svc/healthcheck/"},
+    )
+    assert resp.status_code == 200
+
+
 def test_proxy_envoy_header_query_string_ignored():
     """Envoy's header carries the original :path INCLUDING the query
     string; only the path part may join prefix derivation."""
