@@ -536,7 +536,19 @@ def _bench_serving_load() -> dict:
         def log_message(self, *args):
             pass
 
+    import jax
+
+    from gordo_tpu.server import fastlane
+
     app = build_app({"MODEL_COLLECTION_DIR": collection})
+    platform = jax.devices()[0].platform
+
+    def emit_partial(result):
+        # partial envelope: a leash kill between phases keeps what ran
+        print(
+            json.dumps({"platform": platform, "result": result}), flush=True
+        )
+
     server = wsgiref.simple_server.make_server(
         "127.0.0.1", 0, app, handler_class=_Quiet
     )
@@ -550,23 +562,37 @@ def _bench_serving_load() -> dict:
                 warmup=warmup, samples=100, flight=True,
             )
         }
-        # partial envelope: a leash kill after the QPS phase keeps it
-        import jax
-
-        print(
-            json.dumps(
-                {"platform": jax.devices()[0].platform, "result": out}
-            ),
-            flush=True,
-        )
+        emit_partial(out)
         out["ramp"] = load_test.run(
             host=host, project="bench", machine=machine_out.name,
             mode="ramp", ramp_users=[1, 2, 4],
             duration=max(1.0, duration / 3), warmup=min(warmup, 0.5),
             samples=100, flight=False,
         )
+        emit_partial(out)
     finally:
         server.shutdown()
+
+    # the fast-lane arm (ISSUE 7): the SAME app behind the socket-level
+    # front end, same open-loop schedule — the on/off A/B for the record.
+    # Failure here must not cost the section its WSGI numbers.
+    try:
+        fl_server = fastlane.FastLaneServer(app, host="127.0.0.1", port=0)
+        threading.Thread(
+            target=fl_server.serve_forever, daemon=True
+        ).start()
+        try:
+            out["fastlane_qps"] = load_test.run(
+                host=f"http://127.0.0.1:{fl_server.server_port}",
+                project="bench", machine=machine_out.name,
+                mode="qps", qps=qps, users=users, duration=duration,
+                warmup=warmup, samples=100, flight=True,
+            )
+        finally:
+            fl_server.server_close()
+    except Exception as exc:  # noqa: BLE001 — keep the WSGI arm's record
+        out["fastlane_qps"] = {"error": repr(exc)[:300]}
+    emit_partial(out)
     return out
 
 
@@ -574,9 +600,12 @@ def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     """
     BASELINE metric #2: server samples/sec + p50 anomaly latency.
 
-    Serves one of the just-trained models through the real WSGI app and
-    POSTs the reference harness shape (100 samples × n_tags JSON to
-    /anomaly/prediction, reference benchmarks/test_ml_server.py:21-30).
+    Serves one of the just-trained models and POSTs the reference harness
+    shape (100 samples × n_tags JSON to /anomaly/prediction, reference
+    benchmarks/test_ml_server.py:21-30). With ``GORDO_TPU_FAST_LANE=1``
+    the requests go through the socket fast lane (server/fastlane.py)
+    over a persistent local connection — the node's actual serving stack
+    when the knob is on; otherwise through the WSGI app as before.
     """
     import statistics
     import tempfile
@@ -585,6 +614,7 @@ def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     import numpy as np
 
     from gordo_tpu import serializer
+    from gordo_tpu.server import fastlane
     from gordo_tpu.server.server import build_app
 
     if rounds is None:
@@ -597,32 +627,65 @@ def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     serializer.dump(model, model_dir, metadata=machine_out.to_dict())
 
     app = build_app({"MODEL_COLLECTION_DIR": collection})
-    client = app.test_client()
     n_tags = len(machine_out.dataset.tag_list)
     rng = np.random.RandomState(0)
     X = rng.random_sample((samples, n_tags)).tolist()
     body = json.dumps({"X": X, "y": X}).encode()
     path = f"/gordo/v0/bench/{machine_out.name}/anomaly/prediction"
 
-    resp = client.post(path, data=body, content_type="application/json")
-    assert resp.status_code == 200, (resp.status_code, resp.text[:500])
-    times = []
-    phases: dict = {"decode_s": [], "predict_s": [], "encode_s": []}
-    for _ in range(rounds):
-        start = timeit.default_timer()
-        resp = client.post(path, data=body, content_type="application/json")
-        times.append(timeit.default_timer() - start)
-        assert resp.status_code == 200
-        # the per-phase breakdown the server already publishes (PR 2):
-        # where a request's time went — decode vs device vs encode — so a
-        # codec regression is visible in the record, not just the total
-        for raw in resp.headers.get("Server-Timing", "").split(","):
-            name, _, dur = raw.strip().partition(";dur=")
-            if name in phases:
-                try:
-                    phases[name].append(float(dur))
-                except ValueError:
-                    pass
+    fast_lane = fastlane.enabled()
+    if fast_lane:
+        import http.client
+        import threading
+
+        server = fastlane.FastLaneServer(app, host="127.0.0.1", port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.server_port, timeout=60
+        )
+
+        def post():
+            conn.request(
+                "POST", path, body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status, resp.getheader("Server-Timing", "")
+
+    else:
+        client = app.test_client()
+
+        def post():
+            resp = client.post(
+                path, data=body, content_type="application/json"
+            )
+            return resp.status_code, resp.headers.get("Server-Timing", "")
+
+    try:
+        status, _ = post()
+        assert status == 200, status
+        times = []
+        phases: dict = {"decode_s": [], "predict_s": [], "encode_s": []}
+        for _ in range(rounds):
+            start = timeit.default_timer()
+            status, server_timing = post()
+            times.append(timeit.default_timer() - start)
+            assert status == 200
+            # the per-phase breakdown the server already publishes (PR 2):
+            # where a request's time went — decode vs device vs encode —
+            # so a codec regression is visible in the record
+            for raw in server_timing.split(","):
+                name, _, dur = raw.strip().partition(";dur=")
+                if name in phases:
+                    try:
+                        phases[name].append(float(dur))
+                    except ValueError:
+                        pass
+    finally:
+        if fast_lane:
+            conn.close()
+            server.server_close()
     times.sort()
     mean = statistics.fmean(times)
     floor = _d2h_latency_floor_ms()
@@ -637,6 +700,7 @@ def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
     return {
         "rounds": rounds,
         "samples_per_post": samples,
+        "fast_lane": fast_lane,
         "p50_ms": round(p50, 3),
         "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 3),
         "samples_per_sec": round(samples / mean, 1),
@@ -1483,6 +1547,18 @@ def _emit_record(sections: dict, recovered: list):
     torch_mpm = head.get("torch_baseline_machines_per_min") or 0
     mpm = head.get("machines_per_min") or 0
 
+    # the record's platform: the headline's when it ran, else the first
+    # section that reported one — a run with the headline disabled (e.g.
+    # GORDO_TPU_BENCH_SECTIONS=tpu_smoke,serving_load) must not stamp
+    # 'unknown' and break bench_compare's platform matching
+    platform = headline.get("platform")
+    if not platform:
+        for entry in (smoke, serving_load, windowed, batch_ab):
+            if entry.get("platform"):
+                platform = entry["platform"]
+                break
+    platform = platform or "unknown"
+
     # Full detail: written to a file AND printed as an EARLIER stdout line.
     # The FINAL line stays compact (<1KB): round 3's single giant line
     # outgrew the driver's tail capture and truncated the headline value out
@@ -1493,7 +1569,7 @@ def _emit_record(sections: dict, recovered: list):
         "serving_load": serving_load,
         "windowed": windowed,
         "batch_ab": batch_ab,
-        "platform": headline.get("platform", "unknown"),
+        "platform": platform,
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
         "sections": {
             name: _section_status(entry)
@@ -1517,6 +1593,7 @@ def _emit_record(sections: dict, recovered: list):
     smoke_res = smoke.get("result") or {}
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
+    load_fastlane = load_res.get("fastlane_qps") or {}
     load_flight = load_qps.get("flight") or {}
     out = {
         "schema_version": RECORD_SCHEMA_VERSION,
@@ -1526,7 +1603,7 @@ def _emit_record(sections: dict, recovered: list):
         "value": round(mpm, 2) if mpm else None,
         "unit": "machines/min",
         "vs_baseline": round(mpm / torch_mpm, 2) if torch_mpm else None,
-        "platform": headline.get("platform", "unknown"),
+        "platform": platform,
         "mfu": head.get("mfu"),
         "server_samples_per_sec": serving.get("samples_per_sec"),
         "server_p50_anomaly_ms": serving.get("p50_ms"),
@@ -1542,10 +1619,16 @@ def _emit_record(sections: dict, recovered: list):
         "server_load_p50_ms": load_qps.get("p50_ms"),
         "server_load_p99_ms": load_qps.get("p99_ms"),
         "server_load_p999_ms": load_qps.get("p999_ms"),
+        # the socket fast lane's arm of the same open-loop schedule
+        # (ISSUE 7) — the on/off A/B, gated like any load metric
+        "server_load_fastlane_req_per_sec": load_fastlane.get("req_per_sec"),
+        "server_load_fastlane_p50_ms": load_fastlane.get("p50_ms"),
+        "server_load_fastlane_p99_ms": load_fastlane.get("p99_ms"),
         "serving_load": {
             "platform": serving_load.get("platform"),
             "qps_target": load_qps.get("qps_target"),
             "errors": load_qps.get("errors"),
+            "fastlane_errors": load_fastlane.get("errors"),
             "worst_traces": [
                 w.get("trace_id")
                 for w in (load_flight.get("worst_requests") or [])[:3]

@@ -103,6 +103,18 @@ BATCHER_FUSE_WIDTH = telemetry.histogram(
     "batcher",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")),
 )
+PARAM_BANK_RESTACKS = telemetry.counter(
+    "gordo_server_param_bank_restacks_total",
+    "Full device re-uploads of a param bank (capacity growth past a "
+    "power-of-two bucket); warmup pre-registration exists to pay these "
+    "before traffic, so steady-state increments indicate model churn",
+)
+PARAM_BANK_EVICTIONS = telemetry.counter(
+    "gordo_server_param_bank_evictions_total",
+    "Least-recently-used models evicted in place from a full param bank "
+    "(GORDO_TPU_PARAM_BANK_MAX) — the evicted model re-registers into "
+    "the freed slot on its next batched predict",
+)
 
 # ------------------------------------------------- serving resilience (PR 3)
 # wired by server/resilience.py, server/server.py, server/views.py,

@@ -40,6 +40,7 @@ import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -80,7 +81,14 @@ class _Item:
 def _stacked_apply(spec, n_pad: int, batch: int, capacity: int):
     """One compiled program per (spec, padded length, batch bucket, bank
     capacity bucket): gather ``batch`` models' params out of the resident
-    bank by index, then vmap the forward over them."""
+    bank by index, then vmap the forward over them.
+
+    On accelerator backends the stacked input X is *donated*: XLA may
+    alias its device buffer for the output, so the H2D staging buffer of
+    call N and the D2H pull of call N-1 can overlap instead of holding
+    two live copies. The host side double-buffers its staging arrays
+    (``_stacked_inputs``) for the same reason. CPU gets no donation —
+    jax emits an unusable-donation warning per call there."""
     import jax
     import jax.numpy as jnp
 
@@ -105,7 +113,8 @@ def _stacked_apply(spec, n_pad: int, batch: int, capacity: int):
         params = jax.tree_util.tree_map(lambda a: a[model_idx], bank_params)
         return jax.vmap(one)(params, X)
 
-    return jax.jit(gathered)
+    donate = (2,) if jax.default_backend() in ("tpu", "gpu") else ()
+    return jax.jit(gathered, donate_argnums=donate)
 
 
 @functools.lru_cache(maxsize=256)
@@ -141,35 +150,81 @@ class _ParamBank:
     """Device-resident stacked params for every model of one spec.
 
     Each model's pytree is stacked into the bank ONCE (on its first batched
-    predict); after that a batch call ships only an int32 index vector and
-    the inputs. Restacking params per call was measured at ~30 ms/model over
-    the device link — it made the batcher lose its own A/B in round 2.
-    Capacity grows in powers of two so the gather program recompiles only
-    when the model count crosses a bucket boundary.
+    predict, or ahead of traffic by warmup's commit-once pre-registration
+    — server/warmup.py); after that a batch call ships only an int32 index
+    vector and the inputs. Restacking params per call was measured at
+    ~30 ms/model over the device link — it made the batcher lose its own
+    A/B in round 2. Capacity grows in powers of two so the gather program
+    recompiles only when the model count crosses a bucket boundary.
+
+    At capacity (``GORDO_TPU_PARAM_BANK_MAX``, default 512) the bank
+    evicts the least-recently-used model *in place*: the newcomer's
+    params overwrite the victim's slot on device (one ``.at[slot].set``,
+    no restack), its host pytree reference replaces the victim's in
+    ``trees`` — so host memory is bounded under model churn instead of
+    retaining every pytree ever registered — and every OTHER slot stays
+    valid (the old clear-everything reset stranded the whole bank's
+    in-flight slot resolutions on the ``generation`` check).
+
+    Thread-safe: warmup registers from the boot thread while the
+    dispatcher registers from the batcher thread.
     """
 
     MAX_MODELS = 512
 
     def __init__(self):
-        self.slots: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        # id(params) -> slot, in LRU order (oldest touch first)
+        self.slots: "OrderedDict[int, int]" = OrderedDict()
         self.trees: List[Any] = []
         self.stacked: Any = None
         self.capacity = 0
-        # bumped on every bank reset so callers resolving a batch of slots
-        # can detect that earlier-resolved slots went stale mid-batch
+        # bumped on every eviction so callers resolving a batch of slots
+        # can detect that earlier-resolved slots went stale mid-batch.
+        # (LRU order makes that near-impossible — a slot resolved moments
+        # ago is MRU, never the victim — but the guard stays.)
         self.generation = 0
+        raw = os.environ.get("GORDO_TPU_PARAM_BANK_MAX", "")
+        try:
+            configured = int(raw) if raw.strip() else 0
+        except ValueError:
+            logger.warning(
+                "invalid GORDO_TPU_PARAM_BANK_MAX=%r; using %d",
+                raw, self.MAX_MODELS,
+            )
+            configured = 0
+        self.max_models = configured if configured > 0 else self.MAX_MODELS
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.trees)
 
     def slot_of(self, params) -> int:
+        with self._lock:
+            return self._slot_of_locked(params)
+
+    def _slot_of_locked(self, params) -> int:
         key = id(params)
         slot = self.slots.get(key)
         if slot is not None:
+            self.slots.move_to_end(key)  # touch: now MRU
             return slot
-        if len(self.trees) >= self.MAX_MODELS:
-            # bank full (e.g. long-lived server with heavy model churn):
-            # start over; old entries re-register on their next predict
-            self.slots.clear()
-            self.trees.clear()
+        import jax
+
+        if len(self.trees) >= self.max_models:
+            # bank full (long-lived server under model churn): evict the
+            # LRU entry in place — one on-device slot write, no restack,
+            # no strand of the other resident models
+            _victim_key, slot = self.slots.popitem(last=False)
+            metric_catalog.PARAM_BANK_EVICTIONS.inc()
             self.generation += 1
+            self.trees[slot] = params  # drops the victim's host pytree
+            self.slots[key] = slot
+            self.stacked = jax.tree_util.tree_map(
+                lambda bank, leaf: bank.at[slot].set(leaf),
+                self.stacked, params,
+            )
+            return slot
         slot = len(self.trees)
         self.trees.append(params)  # keeps `params` alive, so id() stays unique
         self.slots[key] = slot
@@ -180,12 +235,11 @@ class _ParamBank:
         cap = 8
         while cap < len(self.trees):
             cap <<= 1
+        cap = min(cap, max(8, self.max_models))
         if cap == self.capacity:
             # capacity unchanged: write the one new tree into its slot
             # in place rather than re-uploading the whole bank (O(N^2)
             # stacking across N registrations otherwise)
-            import jax
-
             self.stacked = jax.tree_util.tree_map(
                 lambda bank, leaf: bank.at[slot].set(leaf), self.stacked, params
             )
@@ -197,6 +251,7 @@ class _ParamBank:
         import jax
         import jax.numpy as jnp
 
+        metric_catalog.PARAM_BANK_RESTACKS.inc()
         pad = [self.trees[0]] * (cap - len(self.trees))
         self.stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves), *(self.trees + pad)
@@ -239,9 +294,10 @@ class CrossModelBatcher:
         # bucket): _device_call used to np.stack a fresh (b_pad, *shape)
         # array plus an index vector per fused call — steady-state serving
         # re-allocates the identical buffers thousands of times a second.
-        # Only the dispatcher thread fills/ships them, and jax copies host
-        # inputs at dispatch, so reuse across calls is safe.
-        self._stack_buffers: Dict[Tuple, Tuple[np.ndarray, np.ndarray]] = {}
+        # Each entry holds TWO (X, idx) pairs plus a toggle (double
+        # buffering — see _stacked_inputs); only the dispatcher thread
+        # fills/ships them.
+        self._stack_buffers: Dict[Tuple, list] = {}
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
@@ -263,6 +319,23 @@ class CrossModelBatcher:
         (0.0 between calls) — read by the device watchdog."""
         t0 = self._busy_since
         return 0.0 if t0 is None else max(0.0, time.monotonic() - t0)
+
+    def register_params(self, spec, params) -> int:
+        """Commit one model's params into its spec's device-resident bank
+        ahead of traffic (warmup's commit-once pre-registration). Lazy
+        registration restacks the bank every time capacity crosses a
+        power-of-two bucket — registering the whole expected fleet at
+        boot settles the final capacity once, so the first fused call
+        after startup gathers from a bank that never restacks again (and
+        warmup's predicts compile the gather program at that final
+        capacity, not an interim one). Returns the assigned slot."""
+        bank = self._banks.setdefault(spec, _ParamBank())
+        return bank.slot_of(params)
+
+    def bank_size(self, spec) -> int:
+        """Resident models in the spec's bank (0 when no bank exists)."""
+        bank = self._banks.get(spec)
+        return 0 if bank is None else len(bank)
 
     def submit(self, spec, params, X) -> Optional[np.ndarray]:
         """Blocking predict through the batch queue (thread-safe).
@@ -553,23 +626,38 @@ class CrossModelBatcher:
     def _stacked_inputs(
         self, items: List[_Item], slots: List[int], b_pad: int
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Fill (and reuse) the per-fuse-width stacking buffers instead of
-        allocating a fresh (b_pad, *shape) array + index vector per call.
-        Pad lanes repeat item 0 (same values the old np.stack shipped)."""
+        """Fill (and reuse) pinned per-fuse-width stacking buffers instead
+        of allocating a fresh (b_pad, *shape) array + index vector per
+        call. Pad lanes repeat item 0 (same values the old np.stack
+        shipped).
+
+        DOUBLE-buffered per key: jax dispatches device calls
+        asynchronously, and with donated inputs (``_stacked_apply``) the
+        previous call's H2D buffer may still be feeding the device while
+        the dispatcher assembles the next fuse — alternating between two
+        staging arrays lets consecutive fused calls overlap
+        fill/H2D/compute instead of serializing on one shared buffer."""
         sample = items[0].X_pad
         key = (sample.shape, sample.dtype.str, b_pad)
-        pair = self._stack_buffers.get(key)
-        if pair is None:
+        entry = self._stack_buffers.get(key)
+        if entry is None:
             if len(self._stack_buffers) >= 64:
                 # bounded: shapes are bucketed, but a pathological client
                 # mix must not grow this into a leak
                 self._stack_buffers.clear()
-            pair = (
-                np.empty((b_pad,) + sample.shape, dtype=sample.dtype),
-                np.empty(b_pad, dtype=np.int32),
-            )
-            self._stack_buffers[key] = pair
-        X, idx = pair
+            entry = [
+                tuple(
+                    (
+                        np.empty((b_pad,) + sample.shape, dtype=sample.dtype),
+                        np.empty(b_pad, dtype=np.int32),
+                    )
+                )
+                for _ in range(2)
+            ] + [0]
+            self._stack_buffers[key] = entry
+        toggle = entry[2]
+        entry[2] = 1 - toggle
+        X, idx = entry[toggle]
         for i, item in enumerate(items):
             X[i] = item.X_pad
         X[len(items):] = sample
@@ -598,13 +686,31 @@ class CrossModelBatcher:
             b_pad <<= 2
         b_pad = min(b_pad, self.max_batch)
         bank = self._banks.setdefault(spec, _ParamBank())
+        if len({id(it.params) for it in items}) > bank.max_models:
+            # more distinct models than the bank can hold at once: raising
+            # here hands the group to the recovery ladder, which bisects it
+            # into bank-sized halves (and bottoms out in the bankless
+            # serial rescue) — never a silent wrong-params gather
+            raise RuntimeError(
+                f"fused group of {len(items)} spans more distinct models "
+                f"than the param bank holds ({bank.max_models}); bisecting"
+            )
         gen = bank.generation
         slots = [bank.slot_of(it.params) for it in items]
         if bank.generation != gen:
-            # a bank reset occurred mid-resolution: slots resolved before the
-            # reset point into the old bank — re-resolve (a second pass can't
-            # reset again: max_batch << MAX_MODELS)
+            # an LRU eviction occurred mid-resolution (concurrent warmup
+            # registration, or this batch itself churning a full bank):
+            # slots resolved before the eviction may point at overwritten
+            # lanes — re-resolve, and if the bank churns AGAIN during the
+            # second pass, fail the group into the recovery ladder rather
+            # than gather from slots of unknown vintage
+            gen = bank.generation
             slots = [bank.slot_of(it.params) for it in items]
+            if bank.generation != gen:
+                raise RuntimeError(
+                    "param bank churned twice during slot resolution; "
+                    "retrying through the recovery ladder"
+                )
         X, idx = self._stacked_inputs(items, slots, b_pad)
         # the busy window feeds the device watchdog: a wedged call here is
         # what flips /healthcheck to 503 (resilience.stuck_device_call_s)

@@ -49,9 +49,37 @@ try:  # pragma: no cover - environment-dependent
 except ImportError:
     from json.encoder import encode_basestring_ascii as _escape
 
+try:  # pragma: no cover - environment-dependent
+    from orjson import loads as _loads
+except ImportError:
+    _loads = json.loads
+
 _dumps = json.dumps
 _add = str.__add__
 _join = ", ".join
+
+
+def loads(body):
+    """Parse a JSON request body straight off the socket buffer —
+    orjson when importable, the stdlib C decoder otherwise. Accepts
+    bytes/bytearray/memoryview/str; raises ``ValueError`` on malformed
+    JSON (``orjson.JSONDecodeError`` and ``json.JSONDecodeError`` are
+    both ValueError subclasses). The fast lane (server/fastlane.py) uses
+    this so a request body is parsed exactly once, with no intermediate
+    werkzeug Request object.
+
+    Byte-parity guard: orjson rejects the non-standard ``NaN`` /
+    ``Infinity`` literals the stdlib decoder (and therefore the WSGI
+    lane) accepts — on an orjson parse error the stdlib decoder gets the
+    final word, so both lanes accept exactly the same payloads."""
+    if _loads is json.loads:
+        return _loads(body)
+    try:
+        return _loads(body)
+    except ValueError:
+        if isinstance(body, memoryview):
+            body = bytes(body)
+        return json.loads(body)
 
 
 def enabled() -> bool:

@@ -1,0 +1,593 @@
+"""
+Socket-level serving fast lane for the two hot JSON routes.
+
+After PR 4's codec overhaul, >half of the remaining per-request cost on
+the prediction routes was transport machinery, not work: the HTTP
+server's readline parsing, werkzeug ``Request``/environ construction,
+``Map.bind_to_environ`` routing, ``Response`` + ``ClosingIterator``
+teardown. None of it changes a byte of the response. This module is a
+minimal HTTP/1.1 front end (thread-per-connection, persistent
+connections) that recognises exactly
+
+- ``POST /gordo/v0/<project>/<name>/prediction``
+- ``POST /gordo/v0/<project>/<name>/anomaly/prediction``
+
+and serves them through the SAME core handlers as the WSGI path
+(``views.base_prediction_core`` / ``views.anomaly_prediction_core``) —
+so responses are byte-identical by construction — while skipping every
+per-request werkzeug object. The request body is parsed straight off the
+socket buffer (``fast_codec.loads``: orjson-first), the model resolves
+through the cached serving-info path, resilience semantics (admission
+gate, deadlines, breakers — server/resilience.py, reused not forked) and
+the tracing/flight-recorder contract (``Server-Timing``,
+``X-Gordo-Trace`` on every response) are preserved exactly.
+
+**Fallback rule:** anything the fast lane cannot handle byte-identically
+— any other route, a non-POST method, a non-JSON content type (the
+multipart parquet path), proxy-prefix headers
+(``X-Envoy-Original-Path``/``X-Forwarded-Prefix``, which rewrite
+``SCRIPT_NAME``) — is dispatched to the untouched WSGI app in-process
+over a synthesized environ. One port serves everything; the slow lane is
+exactly as slow as before, never broken.
+
+Enabled by ``GORDO_TPU_FAST_LANE=1`` (default off): ``run_server``
+then mounts :class:`FastLaneServer` on the listening socket instead of
+the threaded werkzeug server. The drain contract is preserved — SIGTERM
+stops the accept loop, in-flight requests finish within the drain
+budget, and responses during a drain carry ``Connection: close``.
+"""
+
+import io
+import logging
+import os
+import re
+import socket
+import sys
+import threading
+import timeit
+from http.client import responses as _status_phrases
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, unquote
+
+try:
+    import simplejson
+except ImportError:  # pragma: no cover - environment-dependent
+    from gordo_tpu.util import _simplejson as simplejson
+
+from gordo_tpu.observability import flight, telemetry, tracing
+from gordo_tpu.server import fast_codec, resilience
+from gordo_tpu.server.server import RequestContext
+
+logger = logging.getLogger(__name__)
+
+# hard caps: a request head or body beyond these is a client error, not a
+# reason to buffer unbounded bytes per connection
+MAX_HEAD_BYTES = 64 * 1024
+MAX_BODY_BYTES = 256 * 1024 * 1024
+
+# hot-route recognition without werkzeug routing: one match against the
+# decoded path. Group 2 is the machine name, group 3 distinguishes the
+# anomaly route. strict_slashes=False parity: one trailing slash allowed.
+_HOT_RE = re.compile(
+    r"^/gordo/v0/([^/]+)/([^/]+)/(anomaly/)?prediction/?$"
+)
+_BASE_RULE = "/gordo/v0/<gordo_project>/<gordo_name>/prediction"
+_ANOMALY_RULE = "/gordo/v0/<gordo_project>/<gordo_name>/anomaly/prediction"
+
+
+def enabled() -> bool:
+    """The ``GORDO_TPU_FAST_LANE`` gate (default off)."""
+    return os.environ.get("GORDO_TPU_FAST_LANE", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+# --------------------------------------------------------------- request shim
+class _Headers:
+    """Case-insensitive ``.get`` over the parsed header dict (keys stored
+    lower-case) — the only header interface the core handlers use."""
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw: Dict[str, str]):
+        self._raw = raw
+
+    def get(self, name: str, default=None):
+        return self._raw.get(name.lower(), default)
+
+
+class _Args:
+    """``.get`` over the parsed query string (first value per key, blank
+    values kept — werkzeug ``request.args`` parity for the keys the hot
+    handlers read: format, all_columns, revision)."""
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, query: str):
+        if query:
+            parsed = parse_qs(query, keep_blank_values=True)
+            self._raw = {key: values[0] for key, values in parsed.items()}
+        else:
+            self._raw = {}
+
+    def get(self, name: str, default=None):
+        return self._raw.get(name, default)
+
+
+class PlainRequest:
+    """The duck-typed request the core view handlers consume — built from
+    parsed socket bytes, no werkzeug. ``environ`` carries only the two
+    ``gordo_tpu.*`` attribution keys the metrics/flight layers read."""
+
+    __slots__ = (
+        "method", "path", "headers", "args", "files", "environ",
+        "_body", "_json", "_json_parsed",
+    )
+
+    def __init__(self, method: str, path: str, query: str,
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.headers = _Headers(headers)
+        self.args = _Args(query)
+        self.files: dict = {}
+        self.environ: dict = {}
+        self._body = body
+        self._json = None
+        self._json_parsed = False
+
+    @property
+    def is_json(self) -> bool:
+        mimetype = (
+            (self.headers.get("Content-Type") or "").partition(";")[0].strip().lower()
+        )
+        return mimetype == "application/json" or mimetype.endswith("+json")
+
+    def get_json(self, silent: bool = False):
+        if not self._json_parsed:
+            self._json_parsed = True
+            try:
+                self._json = fast_codec.loads(self._body)
+            except ValueError:
+                self._json = None
+                if not silent:
+                    raise
+        return self._json
+
+
+# ------------------------------------------------------------- HTTP plumbing
+class _ConnectionClosed(Exception):
+    """Peer went away mid-request; just drop the connection."""
+
+
+class _BadRequest(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _recv_until(conn, buf: bytearray, marker: bytes, limit: int) -> int:
+    """Grow ``buf`` from the socket until ``marker`` appears; returns the
+    marker offset. Raises on EOF (clean close between requests is signalled
+    by an empty buffer) or when ``limit`` is exceeded."""
+    while True:
+        idx = buf.find(marker)
+        if idx >= 0:
+            return idx
+        if len(buf) > limit:
+            raise _BadRequest(431, "request head too large")
+        chunk = conn.recv(65536)
+        if not chunk:
+            raise _ConnectionClosed()
+        buf.extend(chunk)
+
+
+def _recv_exact(conn, buf: bytearray, n: int, limit: int) -> bytes:
+    if n > limit:
+        raise _BadRequest(413, "request body too large")
+    while len(buf) < n:
+        chunk = conn.recv(65536)
+        if not chunk:
+            raise _ConnectionClosed()
+        buf.extend(chunk)
+    body = bytes(buf[:n])
+    del buf[:n]
+    return body
+
+
+def _read_chunked(conn, buf: bytearray, limit: int) -> bytes:
+    """Minimal ``Transfer-Encoding: chunked`` body reader (trailers
+    discarded) — rare for these clients, but a chunked POST must not
+    corrupt the connection."""
+    body = bytearray()
+    while True:
+        idx = _recv_until(conn, buf, b"\r\n", MAX_HEAD_BYTES)
+        size_line = bytes(buf[:idx]).split(b";", 1)[0].strip()
+        del buf[: idx + 2]
+        try:
+            size = int(size_line, 16)
+        except ValueError:
+            raise _BadRequest(400, "malformed chunk size")
+        if size == 0:
+            # consume trailers up to the final blank line
+            idx = _recv_until(conn, buf, b"\r\n", MAX_HEAD_BYTES)
+            while idx != 0:
+                del buf[: idx + 2]
+                idx = _recv_until(conn, buf, b"\r\n", MAX_HEAD_BYTES)
+            del buf[:2]
+            return bytes(body)
+        body.extend(_recv_exact(conn, buf, size, limit - len(body)))
+        _recv_exact(conn, buf, 2, 4)  # the chunk's trailing CRLF
+
+
+def _parse_head(head: bytes) -> Tuple[str, str, str, Dict[str, str]]:
+    """(method, target, version, headers) from the raw request head."""
+    try:
+        lines = head.decode("latin-1").split("\r\n")
+        method, target, version = lines[0].split(" ", 2)
+    except (UnicodeDecodeError, ValueError):
+        raise _BadRequest(400, "malformed request line")
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        name, sep, value = line.partition(":")
+        if not sep:
+            raise _BadRequest(400, "malformed header line")
+        key = name.strip().lower()
+        value = value.strip()
+        if key in headers:
+            # WSGI-style comma join for repeated headers
+            headers[key] = headers[key] + "," + value
+        else:
+            headers[key] = value
+    return method, target, version, headers
+
+
+def _serialize(status: int, headers, body, keep_alive: bool) -> bytes:
+    if isinstance(body, str):
+        body = body.encode("utf-8")
+    elif body is None:
+        body = b""
+    phrase = _status_phrases.get(status, "UNKNOWN")
+    out = [f"HTTP/1.1 {status} {phrase}"]
+    out.extend(f"{name}: {value}" for name, value in headers)
+    out.append(f"Content-Length: {len(body)}")
+    out.append(
+        "Connection: keep-alive" if keep_alive else "Connection: close"
+    )
+    return ("\r\n".join(out) + "\r\n\r\n").encode("latin-1") + body
+
+
+# ----------------------------------------------------------------- dispatch
+# hop-by-hop headers a WSGI app must not control (PEP 3333); the fast lane
+# writes its own Content-Length/Connection
+_HOP_BY_HOP = frozenset(
+    (
+        "connection", "keep-alive", "proxy-authenticate",
+        "proxy-authorization", "te", "trailers", "transfer-encoding",
+        "upgrade", "content-length",
+    )
+)
+
+
+class FastLaneServer:
+    """The socket front end: fast-lane dispatch for the two hot routes,
+    in-process WSGI fallback for everything else. API-compatible with the
+    werkzeug server where ``run_server`` touches it (``serve_forever`` /
+    ``shutdown`` / ``server_close`` / ``server_port``)."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
+                 fd: Optional[int] = None, request_timeout: float = 120.0):
+        self.app = app
+        self.request_timeout = request_timeout
+        self._shutdown = threading.Event()
+        if fd is not None:
+            # run_server's prefork path: adopt the shared listening socket
+            self._sock = socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM, fileno=os.dup(fd)
+            )
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self._sock.listen(128)
+        self._sock.settimeout(0.5)
+        self.server_port = self._sock.getsockname()[1]
+        self.host = host
+
+    # ------------------------------------------------------------ lifecycle
+    def serve_forever(self):
+        logger.info(
+            "fast lane serving on port %d (hot routes socket-level, "
+            "everything else via WSGI fallback)", self.server_port,
+        )
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handle_connection, args=(conn,),
+                daemon=True, name="gordo-fastlane",
+            ).start()
+
+    def shutdown(self):
+        self._shutdown.set()
+
+    def server_close(self):
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover - double close
+            pass
+
+    # ----------------------------------------------------------- connection
+    def _handle_connection(self, conn):
+        conn.settimeout(self.request_timeout)
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover - non-TCP sockets in tests
+            pass
+        buf = bytearray()
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    head_end = _recv_until(
+                        conn, buf, b"\r\n\r\n", MAX_HEAD_BYTES
+                    )
+                except _ConnectionClosed:
+                    break
+                head = bytes(buf[:head_end])
+                del buf[: head_end + 4]
+                method, target, version, headers = _parse_head(head)
+                if headers.get("expect", "").lower() == "100-continue":
+                    conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+                if "chunked" in headers.get("transfer-encoding", "").lower():
+                    body = _read_chunked(conn, buf, MAX_BODY_BYTES)
+                else:
+                    try:
+                        length = int(headers.get("content-length", "0") or "0")
+                    except ValueError:
+                        raise _BadRequest(400, "malformed Content-Length")
+                    body = (
+                        _recv_exact(conn, buf, length, MAX_BODY_BYTES)
+                        if length else b""
+                    )
+                client_keep = self._client_keep_alive(version, headers)
+                keep = client_keep and not resilience.is_draining()
+                response_bytes = self._dispatch(
+                    method, target, headers, body, keep
+                )
+                conn.sendall(response_bytes)
+                if not keep:
+                    break
+        except _BadRequest as exc:
+            try:
+                conn.sendall(
+                    _serialize(
+                        exc.status,
+                        [("Content-Type", "application/json")],
+                        simplejson.dumps({"error": exc.message}),
+                        keep_alive=False,
+                    )
+                )
+            except OSError:
+                pass
+        except (socket.timeout, OSError, ConnectionError):
+            pass
+        except _ConnectionClosed:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    @staticmethod
+    def _client_keep_alive(version: str, headers: Dict[str, str]) -> bool:
+        connection = headers.get("connection", "").lower()
+        if "close" in connection:
+            return False
+        if version == "HTTP/1.0":
+            return "keep-alive" in connection
+        return True
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, method: str, target: str, headers: Dict[str, str],
+                  body: bytes, keep_alive: bool) -> bytes:
+        raw_path, _, query = target.partition("?")
+        path = unquote(raw_path, encoding="latin-1")
+        match = _HOT_RE.match(unquote(raw_path))
+        try:
+            if (
+                match is not None
+                and method == "POST"
+                # proxy-prefix deployments rewrite SCRIPT_NAME — WSGI
+                # handles the adaptation; the multipart parquet path needs
+                # werkzeug's form parser
+                and "x-envoy-original-path" not in headers
+                and "x-forwarded-prefix" not in headers
+                and (headers.get("content-type") or "")
+                .partition(";")[0].strip().lower() == "application/json"
+            ):
+                status, extra_headers, out_body = self._handle_hot(
+                    match, unquote(raw_path), query, headers, body
+                )
+                return _serialize(status, extra_headers, out_body, keep_alive)
+            status, out_headers, out_body = self._wsgi_fallback(
+                method, path, query, headers, body
+            )
+            return _serialize(status, out_headers, out_body, keep_alive)
+        except Exception:  # noqa: BLE001 — last resort: the handler stacks
+            # above map errors themselves; anything arriving here is a
+            # framework bug that must produce a 500, not a dead connection
+            logger.exception("fast lane dispatch error")
+            return _serialize(
+                500,
+                [("Content-Type", "application/json")],
+                simplejson.dumps({"error": "Internal server error"}),
+                keep_alive=False,
+            )
+
+    def _handle_hot(self, match, path: str, query: str,
+                    headers: Dict[str, str], body: bytes):
+        """One hot request, werkzeug-free: the exact semantic mirror of
+        ``GordoServer.dispatch_request`` for the two gated prediction
+        endpoints (resilience gate → revision resolution → core handler),
+        sharing every body-producing code path with the WSGI route."""
+        from gordo_tpu.server import views
+
+        app = self.app
+        anomaly = bool(match.group(3))
+        gordo_name = match.group(2)
+        rule = _ANOMALY_RULE if anomaly else _BASE_RULE
+        request = PlainRequest("POST", path, query, headers, body)
+        request.environ["gordo_tpu.rule"] = rule
+        request.environ["gordo_tpu.model"] = gordo_name
+        resilience.request_started()
+        start = timeit.default_timer()
+        try:
+            ctx = RequestContext(app.config)
+            with tracing.request_root(
+                request.headers.get("traceparent")
+            ) as rtrace:
+                with telemetry.span(
+                    "serve_request", method="POST"
+                ) as root_span:
+                    root_span.set_attrs(
+                        endpoint="anomaly_prediction" if anomaly
+                        else "base_prediction",
+                        rule=rule, model=gordo_name,
+                    )
+                    shed = resilience.try_admit()
+                    if shed is not None:
+                        response = views.PlainResponse(
+                            simplejson.dumps(shed), status=503
+                        )
+                        response.headers["Retry-After"] = (
+                            resilience.breaker_retry_after_header(shed)
+                        )
+                    else:
+                        try:
+                            with resilience.request_scope(
+                                model=gordo_name,
+                                deadline_ms=resilience.deadline_ms_from(
+                                    request.headers
+                                ),
+                            ):
+                                response = self._run_core(
+                                    views, ctx, request, gordo_name, anomaly
+                                )
+                        finally:
+                            resilience.release()
+                runtime_s = timeit.default_timer() - ctx.start_time
+                entries = [f"request_walltime_s;dur={runtime_s}"]
+                entries.extend(
+                    f"{name}_s;dur={duration}"
+                    for name, duration in ctx.timings.items()
+                )
+                response.headers["Server-Timing"] = ", ".join(entries)
+                if ctx.revision:
+                    response.headers["revision"] = ctx.revision
+                response.headers["X-Gordo-Trace"] = rtrace.trace_id
+            flight.default_recorder().observe(
+                rtrace.collector,
+                status=response.status,
+                duration_s=runtime_s,
+                endpoint=rule,
+                model=gordo_name,
+            )
+            if app._prometheus is not None:
+                app._prometheus.record(request, response, start)
+            out_headers = [("Content-Type", response.mimetype)]
+            out_headers.extend(response.headers.items())
+            return response.status, out_headers, response.body
+        finally:
+            resilience.request_finished()
+
+    def _run_core(self, views, ctx, request, gordo_name: str, anomaly: bool):
+        """Revision resolution (the app's own, shared) + the shared core
+        handler, with the same error mapping as
+        ``GordoServer._dispatch_endpoint``."""
+        from werkzeug.exceptions import HTTPException
+
+        error = self.app._resolve_revision(ctx, request)
+        if error is not None:
+            return error
+        try:
+            if anomaly:
+                return views.anomaly_prediction_core(ctx, request, gordo_name)
+            return views.base_prediction_core(ctx, request, gordo_name)
+        except HTTPException as exc:
+            # cold path: werkzeug's canonical error page, flattened
+            return views.PlainResponse.from_werkzeug(exc.get_response())
+        except Exception:
+            logger.exception("Unhandled server error")
+            return views.PlainResponse(
+                simplejson.dumps({"error": "Internal server error"}),
+                status=500,
+            )
+
+    # ------------------------------------------------------- WSGI fallback
+    def _wsgi_fallback(self, method: str, path: str, query: str,
+                       headers: Dict[str, str], body: bytes):
+        """Everything the fast lane does not serve byte-identically runs
+        through the untouched WSGI app over a synthesized environ."""
+        environ = {
+            "REQUEST_METHOD": method,
+            "SCRIPT_NAME": "",
+            "PATH_INFO": path,
+            "QUERY_STRING": query,
+            "SERVER_NAME": self.host,
+            "SERVER_PORT": str(self.server_port),
+            "SERVER_PROTOCOL": "HTTP/1.1",
+            "REMOTE_ADDR": "127.0.0.1",
+            "wsgi.version": (1, 0),
+            "wsgi.url_scheme": "http",
+            "wsgi.input": io.BytesIO(body),
+            "wsgi.errors": sys.stderr,
+            "wsgi.multithread": True,
+            "wsgi.multiprocess": False,
+            "wsgi.run_once": False,
+        }
+        if "content-type" in headers:
+            environ["CONTENT_TYPE"] = headers["content-type"]
+        environ["CONTENT_LENGTH"] = str(len(body))
+        for name, value in headers.items():
+            if name in ("content-type", "content-length"):
+                continue
+            environ["HTTP_" + name.upper().replace("-", "_")] = value
+
+        captured: dict = {"status": 500, "headers": []}
+
+        def start_response(status_line, response_headers, exc_info=None):
+            captured["status"] = int(status_line.split(" ", 1)[0])
+            captured["headers"] = response_headers
+
+        chunks = []
+        app_iter = self.app(environ, start_response)
+        try:
+            for chunk in app_iter:
+                if chunk:
+                    chunks.append(chunk)
+        finally:
+            close = getattr(app_iter, "close", None)
+            if close is not None:
+                close()
+        out_headers = [
+            (name, value)
+            for name, value in captured["headers"]
+            if name.lower() not in _HOP_BY_HOP
+        ]
+        return captured["status"], out_headers, b"".join(chunks)
+
+
+def make_server(app, host: str, port: int, fd: Optional[int] = None
+                ) -> FastLaneServer:
+    """Build the fast-lane front end over an (optionally inherited)
+    listening socket — the ``run_server`` mounting point."""
+    return FastLaneServer(app, host=host, port=port, fd=fd)

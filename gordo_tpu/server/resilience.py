@@ -40,6 +40,13 @@ guarantee on the *serving* path, where the failure modes are different:
 set, the request path is behaviorally identical to the pre-resilience
 server (asserted by test_server.py passing unmodified). Knob reference:
 docs/robustness.md "Serving resilience".
+
+This module is transport-agnostic by design: the WSGI dispatch
+(server/server.py) and the socket fast lane (server/fastlane.py) call
+the SAME gate/deadline/breaker/drain functions — the fast lane reuses
+this layer rather than forking it, so a knob behaves identically down
+both lanes (asserted by the parity suite in
+tests/gordo_tpu/test_fastlane.py).
 """
 
 import contextlib

@@ -226,8 +226,13 @@ class GordoServer:
     _REVISION_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 
     # ------------------------------------------------------------ dispatch
-    def _resolve_revision(self, ctx: RequestContext, request: Request):
-        """?revision=/header override with 410 on missing (ref :171-189)."""
+    def _resolve_revision(self, ctx: RequestContext, request):
+        """?revision=/header override with 410 on missing (ref :171-189).
+
+        Duck-typed over ``request.args.get`` / ``request.headers.get`` so
+        the socket fast lane (server/fastlane.py) shares this exact
+        resolution; returns a :class:`views.PlainResponse` on error (the
+        WSGI edge converts, the fast lane writes it straight out)."""
         collection_dir = self.config.get("MODEL_COLLECTION_DIR") or os.environ.get(
             "MODEL_COLLECTION_DIR", ""
         )
@@ -242,10 +247,9 @@ class GordoServer:
                 or not os.path.isdir(candidate)
             ):
                 ctx.revision = revision
-                return Response(
+                return views.PlainResponse(
                     simplejson.dumps({"error": f"Revision '{revision}' not found."}),
                     status=410,
-                    mimetype="application/json",
                 )
             ctx.collection_dir = candidate
             ctx.revision = revision
@@ -446,7 +450,7 @@ class GordoServer:
 
         error = self._resolve_revision(ctx, request)
         if error is not None:
-            response = error
+            response = error.to_werkzeug()
         else:
             try:
                 if endpoint == "healthcheck":
@@ -596,6 +600,23 @@ def run_server(
 
     from werkzeug.serving import make_server
 
+    def _make_http_server(app, listen_sock):
+        """The worker's HTTP front end: the socket fast lane when
+        ``GORDO_TPU_FAST_LANE=1`` (hot prediction routes served at
+        socket level, everything else through the same WSGI app
+        in-process — server/fastlane.py), else the threaded werkzeug
+        server. Both expose serve_forever/shutdown/server_close, so the
+        drain handling below is lane-agnostic."""
+        from gordo_tpu.server import fastlane
+
+        if fastlane.enabled():
+            return fastlane.make_server(
+                app, host, port, fd=listen_sock.fileno()
+            )
+        return make_server(
+            host, port, app, threaded=True, fd=listen_sock.fileno()
+        )
+
     workers = max(1, workers)
     if (
         workers > 1
@@ -678,7 +699,7 @@ def run_server(
         # single worker: serve inline, no arbiter
         app = build_app()
         _maybe_warmup()
-        server = make_server(host, port, app, threaded=True, fd=sock.fileno())
+        server = _make_http_server(app, sock)
         _install_drain_handler(server)
         server.serve_forever()
         _finish_drain(server)
@@ -723,7 +744,7 @@ def run_server(
             # process-local (metrics aggregate via the multiprocess dir)
             app = build_app()
             _maybe_warmup()
-            server = make_server(host, port, app, threaded=True, fd=sock.fileno())
+            server = _make_http_server(app, sock)
             # from here on SIGTERM drains: stop accepting, finish in-flight
             # within the budget, exit — revision rollover no longer cuts
             # responses mid-flight

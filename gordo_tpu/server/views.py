@@ -6,6 +6,16 @@ Route table and response shapes mirror the reference
 prediction, anomaly prediction (smoothed columns dropped unless
 ``?all_columns``), metadata, download-model, model/revision listings.
 Implemented as plain functions over a per-request context (no flask.g).
+
+The two hot routes are split into *core* functions
+(:func:`base_prediction_core` / :func:`anomaly_prediction_core`) that
+operate on a duck-typed request (``.headers.get`` / ``.args.get`` /
+``.get_json`` / ``.is_json`` / ``.files``) and return a
+:class:`PlainResponse` — no werkzeug objects anywhere in the hot path.
+The WSGI wrappers convert to a werkzeug ``Response`` at the very edge;
+the socket fast lane (server/fastlane.py) serializes the
+``PlainResponse`` straight onto the wire. One body-producing code path
+means the two transports are byte-identical by construction.
 """
 
 import datetime
@@ -61,17 +71,69 @@ def json_serializer_default(obj):
     )
 
 
-def json_response(ctx, payload: dict, status: int = 200) -> Response:
+class PlainResponse:
+    """A response as plain data — status, body, mimetype, extra headers —
+    with no werkzeug objects. The hot handlers produce these; the WSGI
+    edge converts via :meth:`to_werkzeug`, the socket fast lane writes
+    them to the wire directly."""
+
+    __slots__ = ("body", "status", "mimetype", "headers")
+
+    def __init__(
+        self,
+        body,
+        status: int = 200,
+        mimetype: str = "application/json",
+        headers: dict = None,
+    ):
+        self.body = body
+        self.status = status
+        self.mimetype = mimetype
+        self.headers = headers if headers is not None else {}
+
+    @property
+    def status_code(self) -> int:
+        # parity with werkzeug Response (prometheus record, tests)
+        return self.status
+
+    def to_werkzeug(self) -> Response:
+        response = Response(
+            self.body, status=self.status, mimetype=self.mimetype
+        )
+        for name, value in self.headers.items():
+            response.headers[name] = value
+        return response
+
+    @classmethod
+    def from_werkzeug(cls, response: Response) -> "PlainResponse":
+        """Flatten a werkzeug Response (the cold error paths — werkzeug
+        HTTPException pages) into plain data the fast lane can write."""
+        return cls(
+            response.get_data(),
+            status=response.status_code,
+            mimetype=response.mimetype,
+            headers={
+                name: value
+                for name, value in response.headers.items()
+                if name.lower() not in ("content-length", "content-type")
+            },
+        )
+
+
+def json_body(ctx, payload: dict, status: int = 200) -> PlainResponse:
     payload = dict(payload)
     payload["revision"] = ctx.revision
-    return Response(
+    return PlainResponse(
         simplejson.dumps(payload, ignore_nan=True, default=json_serializer_default),
         status=status,
-        mimetype="application/json",
     )
 
 
-def frame_response(ctx, request, df: pd.DataFrame, extra: dict) -> Response:
+def json_response(ctx, payload: dict, status: int = 200) -> Response:
+    return json_body(ctx, payload, status).to_werkzeug()
+
+
+def frame_body(ctx, request, df: pd.DataFrame, extra: dict) -> PlainResponse:
     """Serialize a prediction response frame as ``{"data": ..., **extra,
     "revision": ...}`` — through the numpy-native fast codec when enabled
     (byte-identical output), else the pandas dict path."""
@@ -87,10 +149,14 @@ def frame_response(ctx, request, df: pd.DataFrame, extra: dict) -> Response:
                     rest, ignore_nan=True, default=json_serializer_default
                 ),
             )
-            return Response(body, status=200, mimetype="application/json")
+            return PlainResponse(body, status=200)
         metric_catalog.FAST_CODEC_FALLBACK.labels(op="encode").inc()
     payload = {"data": server_utils.dataframe_to_dict(df), **extra}
-    return json_response(ctx, payload, 200)
+    return json_body(ctx, payload, 200)
+
+
+def frame_response(ctx, request, df: pd.DataFrame, extra: dict) -> Response:
+    return frame_body(ctx, request, df, extra).to_werkzeug()
 
 
 class ModelContext:
@@ -192,10 +258,10 @@ def extract_X_y(request, mc: ModelContext):
 
 
 # ------------------------------------------------------------------- routes
-def _breaker_response(ctx, info: dict) -> Response:
+def _breaker_body(ctx, info: dict) -> PlainResponse:
     """Fast 503 from an open circuit breaker: JSON body naming the model
     and the retry horizon, plus the Retry-After header."""
-    response = json_response(ctx, info, 503)
+    response = json_body(ctx, info, 503)
     response.headers["Retry-After"] = resilience.breaker_retry_after_header(
         info
     )
@@ -216,7 +282,7 @@ def _load_model_guarded(ctx, breaker, gordo_name: str):
         logger.error(
             "Failed to load model %r:\n%s", gordo_name, traceback.format_exc()
         )
-        return None, json_response(
+        return None, json_body(
             ctx,
             {"error": f"Model '{gordo_name}' failed to load"},
             500,
@@ -225,11 +291,15 @@ def _load_model_guarded(ctx, breaker, gordo_name: str):
 
 
 def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    return base_prediction_core(ctx, request, gordo_name).to_werkzeug()
+
+
+def base_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
     breaker = resilience.breaker_for(gordo_name)
     if breaker is not None:
         open_info = breaker.allow()
         if open_info is not None:
-            return _breaker_response(ctx, open_info)
+            return _breaker_body(ctx, open_info)
     # force 404 (and breaker-recorded load failures) before payload parsing
     mc, load_error = _load_model_guarded(ctx, breaker, gordo_name)
     if load_error is not None:
@@ -238,7 +308,7 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
         with ctx.phase("decode"):
             X, y = extract_X_y(request, mc)
     except (server_utils.BadDataFrame, ValueError) as exc:
-        return json_response(ctx, {"message": str(exc)}, 400)
+        return json_body(ctx, {"message": str(exc)}, 400)
 
     context: dict = {}
     start = timeit.default_timer()
@@ -252,22 +322,22 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
             resilience.check_output_finite(output, gordo_name)
     except resilience.DeadlineExceeded as err:
         logger.warning("Deadline exceeded predicting %r: %s", gordo_name, err)
-        return json_response(ctx, {"error": str(err)}, 504)
+        return json_body(ctx, {"error": str(err)}, 504)
     except faults.NonFiniteDataError as err:
         # a server-side model fault (poisoned/diverged artifact), not a
         # client data problem: 500, and the breaker counts it
         resilience.record_breaker_failure(breaker, err)
         logger.error("Non-finite output predicting %r: %s", gordo_name, err)
-        return json_response(ctx, {"error": str(err)}, 500)
+        return json_body(ctx, {"error": str(err)}, 500)
     except ValueError as err:
         logger.error("Failed to predict: %s\n%s", err, traceback.format_exc())
         context["error"] = f"ValueError: {str(err)}"
-        return json_response(ctx, context, 400)
+        return json_body(ctx, context, 400)
     except Exception as err:
         resilience.record_breaker_failure(breaker, err)
         logger.error("Failed to predict:\n%s", traceback.format_exc())
         context["error"] = "Something unexpected happened; check your input data"
-        return json_response(ctx, context, 400)
+        return json_body(ctx, context, 400)
     resilience.record_breaker_success(breaker)
 
     with ctx.phase("encode"):
@@ -282,7 +352,7 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
             frequency=mc.frequency,
         )
         if request.args.get("format") == "parquet":
-            return Response(
+            return PlainResponse(
                 server_utils.dataframe_into_parquet_bytes(data),
                 mimetype="application/octet-stream",
             )
@@ -290,22 +360,26 @@ def base_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Respon
         # encode_s covers the full response-assembly cost (the dumps used
         # to run untimed after the phase closed)
         context["time-seconds"] = f"{timeit.default_timer() - start:.4f}"
-        return frame_response(ctx, request, data, context)
+        return frame_body(ctx, request, data, context)
 
 
 def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Response:
+    return anomaly_prediction_core(ctx, request, gordo_name).to_werkzeug()
+
+
+def anomaly_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
     start_time = timeit.default_timer()
     breaker = resilience.breaker_for(gordo_name)
     if breaker is not None:
         open_info = breaker.allow()
         if open_info is not None:
-            return _breaker_response(ctx, open_info)
+            return _breaker_body(ctx, open_info)
     mc, load_error = _load_model_guarded(ctx, breaker, gordo_name)
     if load_error is not None:
         return load_error
 
     if not hasattr(mc.model, "anomaly"):
-        return json_response(
+        return json_body(
             ctx,
             {
                 "message": f"Model is not an AnomalyDetector, it is of type: {type(mc.model)}"
@@ -317,10 +391,10 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
         with ctx.phase("decode"):
             X, y = extract_X_y(request, mc)
     except (server_utils.BadDataFrame, ValueError) as exc:
-        return json_response(ctx, {"message": str(exc)}, 400)
+        return json_body(ctx, {"message": str(exc)}, 400)
 
     if y is None:
-        return json_response(
+        return json_body(
             ctx, {"message": "Cannot perform anomaly detection without 'y'"}, 400
         )
 
@@ -331,9 +405,9 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
             anomaly_df = mc.model.anomaly(X, y, frequency=mc.frequency)
     except resilience.DeadlineExceeded as exc:
         logger.warning("Deadline exceeded predicting %r: %s", gordo_name, exc)
-        return json_response(ctx, {"error": str(exc)}, 504)
+        return json_body(ctx, {"error": str(exc)}, 504)
     except AttributeError as exc:
-        return json_response(
+        return json_body(
             ctx,
             {
                 "message": f"Model is not complete; cannot compute anomalies: {exc}"
@@ -346,7 +420,7 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
         # finiteness-checked (rolling smoothing legitimately yields NaN)
         resilience.record_breaker_failure(breaker, exc)
         logger.error("Non-finite output predicting %r: %s", gordo_name, exc)
-        return json_response(ctx, {"error": str(exc)}, 500)
+        return json_body(ctx, {"error": str(exc)}, 500)
     except Exception as exc:
         # unhandled anomaly failures keep propagating to the generic 500,
         # but the breaker must still see them
@@ -365,14 +439,14 @@ def anomaly_prediction(ctx, request, gordo_project: str, gordo_name: str) -> Res
                 anomaly_df = anomaly_df.drop(columns=drop, level=0)
 
         if request.args.get("format") == "parquet":
-            return Response(
+            return PlainResponse(
                 server_utils.dataframe_into_parquet_bytes(anomaly_df),
                 mimetype="application/octet-stream",
             )
         context = {
             "time-seconds": f"{timeit.default_timer() - start_time:.4f}",
         }
-        return frame_response(ctx, request, anomaly_df, context)
+        return frame_body(ctx, request, anomaly_df, context)
 
 
 def metadata_view(ctx, request, gordo_project: str, gordo_name: str) -> Response:

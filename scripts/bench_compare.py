@@ -55,6 +55,10 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     ("server_p50_net_of_floor_ms", False),
     ("server_load_req_per_sec", True),
     ("server_load_p99_ms", False),
+    # fast-lane arm of serving_load (ISSUE 7): absent in pre-fast-lane
+    # records, so it only gates once both sides of a pair carry it
+    ("server_load_fastlane_req_per_sec", True),
+    ("server_load_fastlane_p99_ms", False),
 )
 
 # which harness section feeds each metric (schema v2 records carry a

@@ -41,6 +41,9 @@ _NUMERIC_KEYS = (
     "server_d2h_floor_ms", "server_p50_net_of_floor_ms",
     "server_load_req_per_sec", "server_load_p50_ms",
     "server_load_p99_ms", "server_load_p999_ms",
+    # the socket fast lane's arm of the serving_load section (ISSUE 7)
+    "server_load_fastlane_req_per_sec", "server_load_fastlane_p50_ms",
+    "server_load_fastlane_p99_ms",
 )
 
 

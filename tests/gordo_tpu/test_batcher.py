@@ -497,3 +497,94 @@ def test_nan_poisoned_lane_fails_alone_under_output_guard(
         np.testing.assert_allclose(
             results[i], models[i].predict(X_ok), rtol=1e-6, atol=1e-7
         )
+
+
+# --------------------------------------------------- param-bank residency
+def test_param_bank_lru_eviction_bounds_host_memory(monkeypatch):
+    """Churning more models than the bank holds evicts LRU entries IN
+    PLACE: host retention stays bounded (`trees` never exceeds the cap),
+    surviving slots keep answering correctly, and an evicted model
+    re-registers into a freed slot with correct results — no
+    clear-everything reset, no stranded cohort."""
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    monkeypatch.setenv("GORDO_TPU_PARAM_BANK_MAX", "4")
+    b = CrossModelBatcher(window_ms=0, max_batch=8)
+    fleet = [_fitted_autoencoder(seed) for seed in range(7)]
+    rng = np.random.RandomState(3)
+    X = rng.rand(16, 4).astype(np.float32)
+    direct = [m.predict(X) for m in fleet]
+
+    evictions_before = metric_catalog.PARAM_BANK_EVICTIONS.value()
+    # churn well past capacity, twice over
+    for _round in range(2):
+        for i, m in enumerate(fleet):
+            got = b.submit(m.spec_, m.params_, X)
+            np.testing.assert_allclose(got, direct[i], rtol=1e-6, atol=1e-7)
+
+    spec = fleet[0].spec_
+    bank = b._banks[spec]
+    assert len(bank.trees) <= 4
+    assert len(bank.slots) <= 4
+    assert metric_catalog.PARAM_BANK_EVICTIONS.value() > evictions_before
+    # the retained pytrees are exactly the slot-resident ones (no ghost
+    # references keeping evicted params alive)
+    assert len(bank.trees) == len(bank.slots)
+
+    # an evicted early model still predicts correctly after re-registering
+    got = b.submit(fleet[0].spec_, fleet[0].params_, X)
+    np.testing.assert_allclose(got, direct[0], rtol=1e-6, atol=1e-7)
+
+
+def test_param_bank_register_params_prefills_slots(models):
+    """Explicit registration (the warmup commit-once path) places params
+    in the bank ahead of any submit; the subsequent batched predict finds
+    its slot resident and returns correct values."""
+    b = CrossModelBatcher(window_ms=0, max_batch=8)
+    spec = models[0].spec_
+    slots = [b.register_params(m.spec_, m.params_) for m in models]
+    assert slots == [0, 1, 2]
+    assert b.bank_size(spec) == 3
+    # re-registration is idempotent
+    assert b.register_params(models[1].spec_, models[1].params_) == 1
+
+    rng = np.random.RandomState(4)
+    X = rng.rand(12, 4).astype(np.float32)
+    got = b.submit(models[2].spec_, models[2].params_, X)
+    np.testing.assert_allclose(
+        got, models[2].predict(X), rtol=1e-6, atol=1e-7
+    )
+    assert b.bank_size(spec) == 3  # submit registered nothing new
+
+
+def test_warmup_preregisters_params_no_restack_at_first_traffic(
+    model_collection_directory, trained_model_directories, monkeypatch
+):
+    """Satellite: warmup pre-registers every artifact's params into the
+    batcher's param bank, so the first fused call of real traffic never
+    restacks — asserted via the gordo_server_param_bank_* counters."""
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.server import warmup
+    from gordo_tpu.server.utils import load_model
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+
+    result = warmup.warmup_collection(model_collection_directory)
+    assert result["failed"] == []
+    assert result["registered_params"] >= result["models"]
+
+    b = batcher_mod.peek_batcher()
+    assert b is not None
+    assert sum(b.bank_size(spec) for spec in b._banks) >= result["models"]
+
+    restacks_after_warmup = metric_catalog.PARAM_BANK_RESTACKS.value()
+    # first post-warmup traffic: same artifacts, fresh submits
+    rng = np.random.RandomState(5)
+    for name in trained_model_directories:
+        model = load_model(model_collection_directory, name)
+        X = rng.rand(40, 4)
+        model.predict(X)
+    assert (
+        metric_catalog.PARAM_BANK_RESTACKS.value() == restacks_after_warmup
+    ), "post-warmup traffic restacked a param bank"

@@ -503,6 +503,14 @@ def test_bench_serving_load_section(monkeypatch):
     assert qps["p999_ms"] >= qps["p50_ms"] > 0
     assert qps["flight"]["available"] is True
     assert [s["users"] for s in result["ramp"]["steps"]] == [1, 2, 4]
+    # the fast-lane arm (ISSUE 7): same schedule through the socket front
+    # end, including the /debug/flight pull over the WSGI fallback
+    fastlane_qps = result["fastlane_qps"]
+    assert "error" not in fastlane_qps, fastlane_qps
+    assert fastlane_qps["requests"] > 0
+    assert fastlane_qps["errors"] == 0
+    assert fastlane_qps["p999_ms"] >= fastlane_qps["p50_ms"] > 0
+    assert fastlane_qps["flight"]["available"] is True
 
 
 # ------------------------------------------------------- bench_compare gate

@@ -1,0 +1,596 @@
+"""
+Fast-lane (socket) vs WSGI parity and behavior tests (ISSUE 7).
+
+The fast lane's contract is *byte identity*: for the two hot prediction
+routes it must produce the same body, the same error classes, and the
+same tracing headers (``X-Gordo-Trace``/``Server-Timing``) as the WSGI
+path — the only permitted divergence is wall-clock-derived values
+(``time-seconds``, deadline/retry remainders), which these tests
+normalize before comparing bytes.
+"""
+
+import http.client
+import json
+import re
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.server import build_app, fastlane
+from gordo_tpu.server import resilience
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.server.utils import dataframe_to_dict
+
+
+@pytest.fixture(scope="module")
+def app(model_collection_directory, trained_model_directories):
+    server_utils.clear_model_caches()
+    return build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+
+
+@pytest.fixture(scope="module")
+def wsgi_client(app):
+    return app.test_client()
+
+
+@pytest.fixture(scope="module")
+def fast_server(app):
+    server = fastlane.FastLaneServer(app, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def _fast_request(server, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", server.server_port, timeout=60
+    )
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+    finally:
+        conn.close()
+
+
+# wall-clock-derived response fields: the ONLY bytes allowed to differ
+_NORMALIZE = (
+    (re.compile(rb'"time-seconds": "\d+\.\d+"'), b'"time-seconds": "T"'),
+    (re.compile(rb'"retry-after-seconds": [0-9.e+-]+'),
+     b'"retry-after-seconds": R'),
+    (re.compile(rb"\d+ms over budget"), b"Nms over budget"),
+    (re.compile(rb"\(\d+ms remaining at submit\)"), b"(Nms remaining)"),
+)
+
+
+def _normalized(body: bytes) -> bytes:
+    for pattern, replacement in _NORMALIZE:
+        body = pattern.sub(replacement, body)
+    return body
+
+
+def _assert_parity(app_client, fast_server, path, payload=None,
+                   headers=None, method="POST"):
+    """POST the same request down both lanes; assert identical status,
+    identical (normalized) bodies, and identical tracing-header shape.
+    Returns (status, fast_headers, fast_body)."""
+    body = json.dumps(payload).encode() if payload is not None else None
+    send_headers = dict(headers or {})
+    if body is not None:
+        send_headers.setdefault("Content-Type", "application/json")
+    status, fast_headers, fast_body = _fast_request(
+        fast_server, method, path, body=body, headers=send_headers
+    )
+    wsgi = app_client.open(
+        path, method=method, data=body, headers=list(send_headers.items())
+    )
+    assert status == wsgi.status_code, (
+        status, wsgi.status_code, fast_body[:300], wsgi.get_data()[:300]
+    )
+    assert _normalized(fast_body) == _normalized(wsgi.get_data())
+    # tracing headers ride BOTH lanes on every response
+    for lane_headers in (fast_headers, {k.lower(): v for k, v in wsgi.headers}):
+        assert "server-timing" in lane_headers
+        assert "request_walltime_s" in lane_headers["server-timing"]
+        trace = lane_headers.get("x-gordo-trace")
+        assert trace and len(trace) == 32
+    # content type must agree (json vs html error pages vs parquet)
+    assert fast_headers.get("content-type", "").split(";")[0] == (
+        wsgi.headers.get("Content-Type", "").split(";")[0]
+    )
+    return status, fast_headers, fast_body
+
+
+# ------------------------------------------------------------- golden parity
+def _payloads(X_payload):
+    rect = X_payload.values.tolist()
+    with_nan = [list(row) for row in rect]
+    with_nan[0][0] = None
+    return {
+        "rect": {"X": rect, "y": rect},
+        "column_dict": {
+            "X": dataframe_to_dict(X_payload),
+            "y": dataframe_to_dict(X_payload),
+        },
+        "with_null": {"X": with_nan, "y": with_nan},
+    }
+
+
+@pytest.mark.parametrize("kind", ["rect", "column_dict", "with_null"])
+def test_parity_anomaly_golden(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload, kind
+):
+    payload = _payloads(X_payload)[kind]
+    status, headers, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+        payload,
+    )
+    assert status == 200
+    data = json.loads(body)["data"]
+    assert "total-anomaly-scaled" in data
+    # phased Server-Timing on the hot route
+    for phase in ("decode_s", "predict_s", "encode_s"):
+        assert phase in headers["server-timing"]
+
+
+@pytest.mark.parametrize("kind", ["rect", "column_dict"])
+def test_parity_base_prediction_golden(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload, kind
+):
+    payload = {"X": _payloads(X_payload)[kind]["X"]}
+    status, _, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        payload,
+    )
+    assert status == 200
+    assert "model-output" in json.loads(body)["data"]
+
+
+def test_parity_all_columns_query(
+    wsgi_client, fast_server, gordo_project, second_gordo_name, X_payload
+):
+    payload = _payloads(X_payload)["column_dict"]
+    status, _, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{second_gordo_name}/anomaly/prediction"
+        "?all_columns=true",
+        payload,
+    )
+    assert status == 200
+    assert any(k.startswith("smooth-") for k in json.loads(body)["data"])
+
+
+def test_parity_pandas_codec_header(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload
+):
+    """The per-request codec A/B opt-out works identically on the fast
+    lane (the header rides the shim into fast_codec.request_enabled)."""
+    payload = _payloads(X_payload)["column_dict"]
+    status, _, _ = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+        payload,
+        headers={"X-Gordo-Codec": "pandas"},
+    )
+    assert status == 200
+
+
+def test_parity_parquet_format(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload
+):
+    """?format=parquet returns identical parquet bytes down both lanes."""
+    payload = {"X": X_payload.values.tolist()}
+    status, headers, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction?format=parquet",
+        payload,
+    )
+    assert status == 200
+    assert headers["content-type"] == "application/octet-stream"
+    df = server_utils.dataframe_from_parquet_bytes(body)
+    assert "model-output" in df.columns.get_level_values(0)
+
+
+# -------------------------------------------------------------- error classes
+def test_parity_400_missing_X(
+    wsgi_client, fast_server, gordo_project, gordo_name
+):
+    status, _, _ = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", {"noX": 1}
+    )
+    assert status == 400
+
+
+def test_parity_400_wrong_width(
+    wsgi_client, fast_server, gordo_project, gordo_name
+):
+    X = pd.DataFrame(np.random.RandomState(0).rand(5, 2))
+    status, _, _ = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        {"X": dataframe_to_dict(X)},
+    )
+    assert status == 400
+
+
+def test_parity_400_anomaly_requires_y(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload
+):
+    status, _, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+        {"X": dataframe_to_dict(X_payload)},
+    )
+    assert status == 400
+    assert "y" in json.loads(body)["message"]
+
+
+def test_parity_404_unknown_model(wsgi_client, fast_server, gordo_project):
+    status, _, _ = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/no-such-model/prediction", {}
+    )
+    assert status == 404
+
+
+def test_parity_410_unknown_revision(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload
+):
+    status, _, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction?revision=999",
+        {"X": X_payload.values.tolist()},
+    )
+    assert status == 410
+    assert "not found" in json.loads(body)["error"]
+
+
+def test_parity_shed_503(
+    wsgi_client, fast_server, gordo_project, gordo_name, monkeypatch
+):
+    monkeypatch.setenv("GORDO_TPU_MAX_INFLIGHT", "1")
+    assert resilience.try_admit() is None  # occupy the only slot
+    try:
+        status, headers, _ = _assert_parity(
+            wsgi_client, fast_server,
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", {}
+        )
+        assert status == 503
+        assert headers.get("retry-after")
+    finally:
+        resilience.release()
+
+
+def test_parity_breaker_503(
+    wsgi_client, fast_server, gordo_project, gordo_name, monkeypatch
+):
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "60")
+    try:
+        breaker = resilience.breaker_for(gordo_name)
+        breaker.record_failure(faults.PermanentFault("poisoned artifact"))
+        status, headers, body = _assert_parity(
+            wsgi_client, fast_server,
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", {}
+        )
+        assert status == 503
+        assert gordo_name in json.loads(body)["error"]
+        assert headers.get("retry-after")
+    finally:
+        resilience.reset_breakers()
+
+
+def test_parity_deadline_504(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload,
+    monkeypatch
+):
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        json.dumps(
+            {
+                "rules": [
+                    {
+                        "site": "serve_predict",
+                        # both lanes trip the same wedge: two firings
+                        "times": 2,
+                        "error": "wedge",
+                        "seconds": 0.4,
+                    }
+                ]
+            }
+        ),
+    )
+    faults.reset_plan()
+    try:
+        status, _, _ = _assert_parity(
+            wsgi_client, fast_server,
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+            {"X": dataframe_to_dict(X_payload)},
+            headers={"X-Gordo-Deadline-Ms": "100"},
+        )
+        assert status == 504
+    finally:
+        monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+        faults.reset_plan()
+
+
+def test_traceparent_continued_on_fast_lane(
+    fast_server, gordo_project, gordo_name
+):
+    trace_id = "ab" * 16
+    status, headers, _ = _fast_request(
+        fast_server, "POST",
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+        body=b"{}",
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": f"00-{trace_id}-{'cd' * 8}-01",
+        },
+    )
+    assert status == 400  # no X — but the trace must still continue
+    assert headers["x-gordo-trace"] == trace_id
+
+
+# ------------------------------------------------------------------ fallback
+def test_fallback_healthcheck(fast_server):
+    status, headers, body = _fast_request(fast_server, "GET", "/healthcheck")
+    assert status == 200
+    assert "server-timing" in headers
+
+
+def test_fallback_metadata_parity(
+    wsgi_client, fast_server, gordo_project, gordo_name
+):
+    status, _, body = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/metadata", method="GET"
+    )
+    assert status == 200
+    assert json.loads(body)["metadata"]["name"] == gordo_name
+
+
+def test_fallback_405_wrong_method(
+    wsgi_client, fast_server, gordo_project, gordo_name
+):
+    status, _, _ = _assert_parity(
+        wsgi_client, fast_server,
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction", method="GET"
+    )
+    assert status == 405
+
+
+def test_fallback_proxy_prefix_headers(
+    fast_server, gordo_project, gordo_name, X_payload
+):
+    """Proxy-prefix requests take the WSGI lane (SCRIPT_NAME adaptation)
+    and still serve correctly through the fast-lane port."""
+    local = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    status, _, body = _fast_request(
+        fast_server, "POST", f"/prefixed/ingress{local}",
+        body=json.dumps({"X": X_payload.values.tolist()}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Envoy-Original-Path": "/prefixed/ingress",
+        },
+    )
+    assert status == 200
+    assert "model-output" in json.loads(body)["data"]
+
+
+def test_fallback_multipart_parquet(
+    fast_server, gordo_project, gordo_name, X_payload
+):
+    """A multipart parquet POST is not JSON — it must fall back to WSGI
+    (werkzeug's form parser) and still round-trip."""
+    boundary = "gordofastlaneboundary"
+    parquet = server_utils.dataframe_into_parquet_bytes(X_payload)
+    body = (
+        (f"--{boundary}\r\n"
+         'Content-Disposition: form-data; name="X"; filename="X"\r\n'
+         "Content-Type: application/octet-stream\r\n\r\n").encode()
+        + parquet
+        + f"\r\n--{boundary}--\r\n".encode()
+    )
+    status, _, out = _fast_request(
+        fast_server, "POST",
+        f"/gordo/v0/{gordo_project}/{gordo_name}/prediction?format=parquet",
+        body=body,
+        headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+    )
+    assert status == 200
+    df = server_utils.dataframe_from_parquet_bytes(out)
+    assert "model-output" in df.columns.get_level_values(0)
+
+
+# ------------------------------------------------------- connection behavior
+def test_keep_alive_two_requests_one_connection(
+    fast_server, gordo_project, gordo_name, X_payload
+):
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", fast_server.server_port, timeout=60
+    )
+    body = json.dumps(
+        {"X": X_payload.values.tolist(), "y": X_payload.values.tolist()}
+    ).encode()
+    try:
+        first_trace = None
+        for i in range(2):
+            conn.request(
+                "POST",
+                f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction",
+                body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200
+            assert resp.getheader("Connection") == "keep-alive"
+            trace = resp.getheader("X-Gordo-Trace")
+            if i == 0:
+                first_trace = trace
+            else:
+                assert trace != first_trace  # one trace per request
+            assert "total-anomaly-scaled" in json.loads(data)["data"]
+    finally:
+        conn.close()
+
+
+def test_drain_closes_connections(
+    fast_server, gordo_project, gordo_name
+):
+    """During a graceful drain the fast lane answers with
+    Connection: close so the LB stops reusing this worker."""
+    assert resilience.begin_drain()
+    try:
+        status, headers, _ = _fast_request(
+            fast_server, "POST",
+            f"/gordo/v0/{gordo_project}/{gordo_name}/prediction",
+            body=b"{}", headers={"Content-Type": "application/json"},
+        )
+        assert status == 400
+        assert headers["connection"] == "close"
+    finally:
+        resilience.reset_for_tests()
+
+
+# ------------------------------------------------------------------- chaos
+def test_chaos_breaker_open_while_fast_lane_serves(
+    fast_server, gordo_project, gordo_name, second_gordo_name, X_payload,
+    monkeypatch
+):
+    """Fast lane on, one model's breaker open: concurrent traffic to the
+    healthy model all succeeds with correct values while the poisoned
+    model fast-fails 503 naming itself — fault isolation holds at the
+    socket level."""
+    from gordo_tpu.util import faults
+
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "60")
+    payload = json.dumps(
+        {"X": X_payload.values.tolist(), "y": X_payload.values.tolist()}
+    ).encode()
+    try:
+        breaker = resilience.breaker_for(gordo_name)
+        breaker.record_failure(faults.PermanentFault("poisoned artifact"))
+
+        results = []
+        lock = threading.Lock()
+
+        def post(name):
+            status, _, body = _fast_request(
+                fast_server, "POST",
+                f"/gordo/v0/{gordo_project}/{name}/anomaly/prediction",
+                body=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            with lock:
+                results.append((name, status, body))
+
+        threads = [
+            threading.Thread(
+                target=post,
+                args=(gordo_name if i % 2 else second_gordo_name,),
+            )
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        healthy = [r for r in results if r[0] == second_gordo_name]
+        broken = [r for r in results if r[0] == gordo_name]
+        assert healthy and broken
+        reference = None
+        for _, status, body in healthy:
+            assert status == 200
+            data = json.loads(body)["data"]
+            assert "total-anomaly-scaled" in data
+            if reference is None:
+                reference = data["total-anomaly-scaled"]
+            else:
+                assert data["total-anomaly-scaled"] == reference
+        for _, status, body in broken:
+            assert status == 503
+            assert gordo_name in json.loads(body)["error"]
+    finally:
+        resilience.reset_breakers()
+
+
+def test_fast_lane_with_batcher(
+    app, fast_server, gordo_project, gordo_name, X_payload, monkeypatch
+):
+    """Fast-lane requests submit to the CrossModelBatcher like WSGI ones
+    (the hot path ends at the same fused device call)."""
+    from gordo_tpu.server import batcher as batcher_mod
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    body = json.dumps({"X": X_payload.values.tolist()}).encode()
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+
+    def post():
+        status, _, _ = _fast_request(
+            fast_server, "POST", path, body=body,
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200
+
+    post()  # warm: model load + compile + bank registration
+    threads = [threading.Thread(target=post) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert batcher_mod._batcher is not None
+    assert batcher_mod._batcher.stats["items"] >= 5
+
+
+# -------------------------------------------------------- tier-1 perf smoke
+def test_fast_lane_load_smoke(fast_server, gordo_project, gordo_name):
+    """Satellite: the fast lane survives the real open-loop load generator
+    for a few seconds on CPU with non-degenerate latency histograms. No
+    absolute thresholds — this is a 'it completes and measures' gate, not
+    a benchmark."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parents[2] / "benchmarks")
+    )
+    import load_test
+
+    report = load_test.run(
+        host=f"http://127.0.0.1:{fast_server.server_port}",
+        project=gordo_project,
+        machine=gordo_name,
+        mode="qps",
+        qps=30,
+        users=4,
+        duration=1.5,
+        warmup=0.3,
+        samples=20,
+        flight=False,
+    )
+    assert "error" not in report, report
+    assert report["requests"] > 0
+    assert report["errors"] == 0
+    # non-degenerate histogram: positive, ordered percentiles
+    assert report["p50_ms"] > 0
+    assert report["p99_ms"] >= report["p50_ms"]
+    assert report["max_ms"] >= report["p99_ms"]
+    # the per-phase histograms came through Server-Timing on the fast lane
+    assert "decode" in report["phases"]
+    assert "predict" in report["phases"]

@@ -66,6 +66,22 @@ def _worker_pids(arbiter_pid: int):
 
 @pytest.fixture()
 def server_pool(model_collection_directory, trained_model_directories, tmp_path):
+    yield from _pool(model_collection_directory, tmp_path)
+
+
+@pytest.fixture()
+def server_pool_fastlane(
+    model_collection_directory, trained_model_directories, tmp_path
+):
+    """The same 3-worker prefork pool with the socket fast lane mounted
+    (GORDO_TPU_FAST_LANE=1) — every pool guarantee must hold identically."""
+    yield from _pool(
+        model_collection_directory, tmp_path,
+        extra_env={"GORDO_TPU_FAST_LANE": "1"},
+    )
+
+
+def _pool(model_collection_directory, tmp_path, extra_env=None):
     port = _free_port()
     env = {
         "PATH": os.environ.get("PATH", ""),
@@ -74,6 +90,7 @@ def server_pool(model_collection_directory, trained_model_directories, tmp_path)
         "MODEL_COLLECTION_DIR": model_collection_directory,
         "PROJECT": "gordo-test",
     }
+    env.update(extra_env or {})
     # stderr to a file, not a PIPE: four processes share the stream and an
     # undrained pipe would block a worker mid-request once it fills
     errlog = tmp_path / "server-stderr.log"
@@ -176,6 +193,38 @@ def test_pool_serves_and_survives_worker_kill(
         ) == 3,
         timeout=60,
     ), f"pool never respawned to 3 workers: {_worker_pids(proc.pid)}"
+
+
+def test_pool_fast_lane_serves_hot_and_fallback_routes(
+    server_pool_fastlane, gordo_project, gordo_name, X_payload
+):
+    """run_server with GORDO_TPU_FAST_LANE=1: the prefork pool mounts the
+    socket fast lane on the shared listening socket — hot prediction
+    POSTs, WSGI-fallback routes, and worker-kill survival all hold."""
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    proc, base, errlog = server_pool_fastlane
+    url = f"{base}/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction"
+    frame = dataframe_to_dict(X_payload)
+    payload = {"X": frame, "y": frame}
+
+    status, body = _post_json(url, payload)
+    assert status == 200
+    data = json.loads(body)["data"]
+    assert "total-anomaly-scaled" in data
+
+    # fallback routes answer through the same port
+    status, body = _get(f"{base}/gordo/v0/{gordo_project}/models")
+    assert status == 200
+    assert gordo_name in json.loads(body)["models"]
+
+    workers = _worker_pids(proc.pid)
+    assert len(workers) == 3
+    os.kill(workers[0], signal.SIGKILL)
+    _worker_pids(proc.pid)
+    assert _wait_for(
+        lambda: _post_json(url, payload, timeout=30)[0] == 200, timeout=60
+    ), "fast-lane pool stopped serving after a worker SIGKILL"
 
 
 def test_boot_failure_during_slow_warmup_trips_throttle(tmp_path):
