@@ -50,5 +50,11 @@ bench-gate:
 lint-bench-records:
 	python scripts/lint_bench_record.py
 
+# metric <-> dashboard consistency, both directions: every catalog metric
+# is plotted/documented somewhere, and every gordo_* name a dashboard
+# panel queries exists in a metrics catalog (also runs in tier-1)
+lint-dashboards:
+	python scripts/lint_metric_names.py
+
 .PHONY: image push test dryrun smoke render-gate bench bench-gate \
-	lint-bench-records
+	lint-bench-records lint-dashboards

@@ -432,12 +432,13 @@ def _bench_windowed() -> dict:
         machine_flops = flops_mod.cv_build_flops(
             _windowed_spec(family), n_rows=1008, epochs=WINDOWED_EPOCHS
         )
-        mfu_val = flops_mod.mfu(
+        mfu_val, peak_source = flops_mod.mfu_with_source(
             machine_flops * N_WINDOWED, wall, device_kind, len(jax.devices())
         )
         out[family] = {
             "flops_per_machine": machine_flops,
             "mfu": _sig3(mfu_val),
+            "peak_source": peak_source,
             "n_machines": N_WINDOWED,
             "lookback": LOOKBACK,
             "n_tags": WINDOWED_TAGS,
@@ -516,6 +517,12 @@ def _bench_serving_load() -> dict:
     os.environ.setdefault("GORDO_TPU_DEBUG_ENDPOINTS", "1")
     os.environ.setdefault("GORDO_TPU_FLIGHT_SLOW_S", "0.005")
     os.environ.setdefault("GORDO_TPU_FLIGHT_CAPACITY", "1024")
+    # fleet plane (ISSUE 9): run the load with telemetry shards active so
+    # the record carries the merged cross-worker view — the same shard
+    # write -> merge -> summarize path a prefork /metrics scrape serves
+    os.environ.setdefault(
+        "GORDO_TPU_TELEMETRY_DIR", tempfile.mkdtemp(prefix="bench-telemetry-")
+    )
 
     qps = float(os.environ.get("GORDO_TPU_BENCH_LOAD_QPS", "50"))
     duration = float(os.environ.get("GORDO_TPU_BENCH_LOAD_SECONDS", "6"))
@@ -592,8 +599,47 @@ def _bench_serving_load() -> dict:
             fl_server.server_close()
     except Exception as exc:  # noqa: BLE001 — keep the WSGI arm's record
         out["fastlane_qps"] = {"error": repr(exc)[:300]}
+    out["fleet"] = _serving_fleet_summary(machine_out.name)
     emit_partial(out)
     return out
+
+
+def _serving_fleet_summary(model: str) -> dict:
+    """The merged fleet-plane view of the load that just ran (ISSUE 9):
+    worker census, fleet request counter, and the model's 5m SLO window
+    from the cross-worker merge. The bench child is a one-worker fleet,
+    but the numbers travel the full shard path, so a broken merge shows
+    up as a null/zero record, gated like any other metric."""
+    from gordo_tpu.observability import shared, slo
+
+    if not shared.enabled():
+        return {}
+    try:
+        shared.flush(force=True)
+        fleet = shared.fleet_vars() or {}
+        requests = (
+            (fleet.get("merged") or {})
+            .get("gordo_server_fleet_requests_total", {})
+            .get("series")
+            or {}
+        )
+        total = sum(
+            value for value in requests.values()
+            if isinstance(value, (int, float))
+        )
+        slo_fleet = slo.merge_payloads(shared.fleet_extras("slo"))
+        window = (
+            (slo_fleet.get("models") or {}).get(model) or {}
+        ).get("5m") or {}
+        return {
+            "workers": fleet.get("workers"),
+            "requests_total": total,
+            "p99_ms": window.get("p99_ms"),
+            "error_burn_rate": window.get("error_burn_rate"),
+            "latency_burn_rate": window.get("latency_burn_rate"),
+        }
+    except Exception as exc:  # noqa: BLE001 — keep the load arms' record
+        return {"error": repr(exc)[:300]}
 
 
 def _bench_serving(built, rounds: int = None, samples: int = 100) -> dict:
@@ -1594,6 +1640,7 @@ def _emit_record(sections: dict, recovered: list):
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
     load_fastlane = load_res.get("fastlane_qps") or {}
+    load_fleet = load_res.get("fleet") or {}
     load_flight = load_qps.get("flight") or {}
     out = {
         "schema_version": RECORD_SCHEMA_VERSION,
@@ -1605,6 +1652,10 @@ def _emit_record(sections: dict, recovered: list):
         "vs_baseline": round(mpm / torch_mpm, 2) if torch_mpm else None,
         "platform": platform,
         "mfu": head.get("mfu"),
+        # which peak the MFU denominators came from: "env" (operator
+        # override), "table" (known chip), or "measured" (GEMM probe —
+        # the CPU fallback that keeps mfu non-null on every backend)
+        "peak_source": head.get("peak_source"),
         "server_samples_per_sec": serving.get("samples_per_sec"),
         "server_p50_anomaly_ms": serving.get("p50_ms"),
         # fixed per-request device->host latency of this backend (the axon
@@ -1624,6 +1675,15 @@ def _emit_record(sections: dict, recovered: list):
         "server_load_fastlane_req_per_sec": load_fastlane.get("req_per_sec"),
         "server_load_fastlane_p50_ms": load_fastlane.get("p50_ms"),
         "server_load_fastlane_p99_ms": load_fastlane.get("p99_ms"),
+        # the fleet observability plane's merged view of the same load
+        # (ISSUE 9): telemetry-shard merge + per-model SLO windows
+        "server_fleet_workers": load_fleet.get("workers"),
+        "server_fleet_requests_total": load_fleet.get("requests_total"),
+        "server_fleet_p99_ms": load_fleet.get("p99_ms"),
+        "server_fleet_error_burn_rate": load_fleet.get("error_burn_rate"),
+        "server_fleet_latency_burn_rate": load_fleet.get(
+            "latency_burn_rate"
+        ),
         "serving_load": {
             "platform": serving_load.get("platform"),
             "qps_target": load_qps.get("qps_target"),
@@ -1648,6 +1708,14 @@ def _emit_record(sections: dict, recovered: list):
             "mfu": {
                 k: v.get("mfu") for k, v in win.items() if isinstance(v, dict)
             },
+            "peak_source": next(
+                (
+                    v.get("peak_source")
+                    for v in win.values()
+                    if isinstance(v, dict)
+                ),
+                None,
+            ),
         },
         "batch_ab": {
             "platform": batch_ab.get("platform"),
@@ -1724,7 +1792,7 @@ def _bench_headline() -> dict:
 
     spec = AutoEncoder(kind="feedforward_hourglass").build_spec(4, 4)
     machine_flops = flops_mod.cv_build_flops(spec, n_rows=1008, epochs=EPOCHS)
-    mfu_val = flops_mod.mfu(
+    mfu_val, peak_source = flops_mod.mfu_with_source(
         machine_flops * N_MACHINES, batched_sec, device_kind, len(jax.devices())
     )
     out = {
@@ -1735,6 +1803,7 @@ def _bench_headline() -> dict:
         "device_kind": device_kind,
         "flops_per_machine": machine_flops,
         "mfu": _sig3(mfu_val),
+        "peak_source": peak_source,
     }
     emit_partial(out)
 

@@ -655,6 +655,185 @@ def resilience_dashboard() -> Dict[str, Any]:
     )
 
 
+def fleet_dashboard() -> Dict[str, Any]:
+    """Fleet observability plane dashboard (ISSUE 9) over the
+    dependency-free shard-merged /metrics view (observability/shared.py):
+    cross-worker traffic, device duty cycle and online MFU, param-bank
+    residency, and per-model SLO burn rates. Like the build/resilience
+    dashboards these series live in the telemetry registry and carry no
+    project label — panels query unselected names. Gauge aggregates are
+    exported without the worker label (sum- or max-merged at scrape), with
+    per-worker series available under worker="<pid>"."""
+    panels = [
+        _timeseries(
+            "Fleet requests by endpoint and status class",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_fleet_requests_total"
+                    "[1m])) by (endpoint, status)",
+                    "legend": "{{endpoint}} {{status}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="reqps",
+            description=(
+                "Counters summed across every worker shard at scrape — no "
+                "prometheus_client multiprocess dir involved"
+            ),
+        ),
+        _timeseries(
+            "Fleet request latency p50 / p99",
+            [
+                {
+                    "expr": (
+                        "histogram_quantile(0.5, sum(rate("
+                        "gordo_server_fleet_request_seconds_bucket[5m]"
+                        ")) by (le, endpoint))"
+                    ),
+                    "legend": "p50 {{endpoint}}",
+                },
+                {
+                    "expr": (
+                        "histogram_quantile(0.99, sum(rate("
+                        "gordo_server_fleet_request_seconds_bucket[5m]"
+                        ")) by (le, endpoint))"
+                    ),
+                    "legend": "p99 {{endpoint}}",
+                },
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            unit="s",
+            description=(
+                "Per-worker histograms merge element-wise before exposition, "
+                "so these quantiles are fleet-exact up to the bucket ladder"
+            ),
+        ),
+        _timeseries(
+            "Device duty cycle & online MFU",
+            [
+                {
+                    "expr": 'max(gordo_server_device_busy_ratio'
+                    '{worker=""} or gordo_server_device_busy_ratio)',
+                    "legend": "busy ratio",
+                },
+                {
+                    "expr": 'max(gordo_server_device_mfu{worker=""} '
+                    "or gordo_server_device_mfu)",
+                    "legend": "online MFU",
+                },
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+            unit="percentunit",
+            description=(
+                "Busy ratio: fraction of the sampling interval the "
+                "dispatcher spent inside fused device calls "
+                "(gordo_server_device_busy_seconds_total differentiated). "
+                "MFU: achieved FLOP/s "
+                "(gordo_server_device_flops_total, useful lanes only) over "
+                "the chip peak — table, env, or measured-GEMM fallback"
+            ),
+        ),
+        _timeseries(
+            "Device memory",
+            [
+                {
+                    "expr": "sum(gordo_server_device_memory_bytes) "
+                    "by (device, stat)",
+                    "legend": "dev{{device}} {{stat}}",
+                }
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            unit="bytes",
+        ),
+        _timeseries(
+            "Param-bank residency & program cache",
+            [
+                {
+                    "expr": "sum(gordo_server_param_bank_bytes)",
+                    "legend": "bank bytes",
+                },
+                {
+                    "expr": "max(gordo_server_param_bank_occupancy)",
+                    "legend": "occupancy",
+                },
+                {
+                    "expr": "sum(gordo_server_program_cache_entries)",
+                    "legend": "compiled programs",
+                },
+            ],
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+        ),
+        _timeseries(
+            "SLO burn rates (worst model)",
+            [
+                {
+                    "expr": "max(gordo_server_slo_error_burn_rate) "
+                    "by (window)",
+                    "legend": "error burn {{window}}",
+                },
+                {
+                    "expr": "max(gordo_server_slo_latency_burn_rate) "
+                    "by (window)",
+                    "legend": "latency burn {{window}}",
+                },
+            ],
+            panel_id=6,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+            description=(
+                "Burn rate 1.0 = consuming budget exactly as fast as "
+                "allowed; the classic multi-window page rule is short-"
+                "window burn > 14.4 AND long-window burn > 1"
+            ),
+        ),
+        _timeseries(
+            "Per-model p99 vs objective",
+            [
+                {
+                    "expr": 'max(gordo_server_slo_p99_ms{window="5m"}) '
+                    "by (model)",
+                    "legend": "{{model}}",
+                }
+            ],
+            panel_id=7,
+            x=0,
+            y=3 * _PANEL_H,
+            unit="ms",
+            description=(
+                "Rolling-window p99 per model (gordo_server_slo_requests "
+                "carries the sample counts behind each point); compare "
+                "against the GORDO_TPU_SLO_P99_MS objective"
+            ),
+        ),
+        _stat(
+            "Workers in fleet view",
+            "max(gordo_server_fleet_workers)",
+            panel_id=8,
+            x=_PANEL_W,
+            y=3 * _PANEL_H,
+        ),
+        _stat(
+            "Device busy seconds (total)",
+            "sum(gordo_server_device_busy_seconds_total)",
+            panel_id=9,
+            x=_PANEL_W + 6,
+            y=3 * _PANEL_H,
+            unit="s",
+        ),
+    ]
+    return _dashboard("Gordo TPU fleet", "gordo-tpu-fleet", panels)
+
+
 def write_dashboards(out_dir: str) -> List[str]:
     """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -664,6 +843,7 @@ def write_dashboards(out_dir: str) -> List[str]:
         ("gordo_tpu_machines.json", machines_dashboard),
         ("gordo_tpu_build.json", build_dashboard),
         ("gordo_tpu_resilience.json", resilience_dashboard),
+        ("gordo_tpu_fleet.json", fleet_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

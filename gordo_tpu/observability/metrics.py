@@ -209,3 +209,100 @@ MODEL_LOAD_FAILURES = telemetry.counter(
     "TTL'd negative cache answered without re-reading the artifact)",
     ("kind",),
 )
+
+# --------------------------------------- fleet observability plane (ISSUE 9)
+# cross-worker aggregation: observability/shared.py merges per-process
+# telemetry shards (GORDO_TPU_TELEMETRY_DIR) into the fleet /metrics view
+FLEET_WORKERS = telemetry.gauge(
+    "gordo_server_fleet_workers",
+    "Telemetry shards merged into the fleet view at the last scrape "
+    "(live worker processes writing under GORDO_TPU_TELEMETRY_DIR)",
+)
+FLEET_REQUESTS = telemetry.counter(
+    "gordo_server_fleet_requests_total",
+    "Requests observed by the dependency-free fleet telemetry plane, by "
+    "matched endpoint rule and status class (summed across workers at "
+    "scrape; the per-worker prometheus_client counters remain the "
+    "per-status-code detail view)",
+    ("endpoint", "status"),
+)
+FLEET_REQUEST_SECONDS = telemetry.histogram(
+    "gordo_server_fleet_request_seconds",
+    "End-to-end request wall time observed by the fleet telemetry plane "
+    "(per-worker histograms merge element-wise at scrape, so fleet "
+    "quantiles are exact up to the bucket ladder)",
+    ("endpoint",),
+)
+
+# device telemetry (observability/device.py): sampled at shard flush and
+# at /metrics / /debug/vars time — never from a background thread
+DEVICE_BUSY_SECONDS = telemetry.counter(
+    "gordo_server_device_busy_seconds_total",
+    "Cumulative wall seconds the batcher dispatcher spent inside fused "
+    "(or serial-rescue) device calls — the duty-cycle numerator",
+)
+DEVICE_BUSY_RATIO = telemetry.gauge(
+    "gordo_server_device_busy_ratio",
+    "Fraction of the last sampling interval the dispatcher spent inside "
+    "device calls (0 = idle accelerator, 1 = dispatch-bound)",
+)
+DEVICE_FLOPS = telemetry.counter(
+    "gordo_server_device_flops_total",
+    "Achieved forward FLOPs of fused serving device calls (useful lanes "
+    "only — padding lanes excluded), per ops/flops.py analytic accounting",
+)
+DEVICE_MFU = telemetry.gauge(
+    "gordo_server_device_mfu",
+    "Online serving MFU: achieved FLOP/s over the last sampling interval "
+    "divided by the chip peak (table, env override, or measured GEMM "
+    "fallback — ops/flops.py peak_flops_with_source)",
+)
+DEVICE_MEMORY = telemetry.gauge(
+    "gordo_server_device_memory_bytes",
+    "JAX device memory stats (bytes_in_use, peak_bytes_in_use, "
+    "bytes_limit) per local device; absent on backends without "
+    "memory_stats (CPU)",
+    ("device", "stat"),
+)
+PARAM_BANK_BYTES = telemetry.gauge(
+    "gordo_server_param_bank_bytes",
+    "Device-resident bytes held by the cross-model batcher's stacked "
+    "param banks (all specs summed)",
+)
+PARAM_BANK_OCCUPANCY = telemetry.gauge(
+    "gordo_server_param_bank_occupancy",
+    "Used fraction of the param banks' stacked capacity (used slots over "
+    "power-of-two capacity, all specs pooled)",
+)
+PROGRAM_CACHE_ENTRIES = telemetry.gauge(
+    "gordo_server_program_cache_entries",
+    "Compiled serving programs resident in the batcher's lru_caches "
+    "(stacked-apply + serial-rescue variants)",
+)
+
+# per-model SLOs (observability/slo.py): rolling 5m/1h windows, burn rates
+# against GORDO_TPU_SLO_P99_MS / GORDO_TPU_SLO_ERROR_BUDGET
+SLO_REQUESTS = telemetry.gauge(
+    "gordo_server_slo_requests",
+    "Requests in the model's rolling SLO window",
+    ("model", "window"),
+)
+SLO_P99_MS = telemetry.gauge(
+    "gordo_server_slo_p99_ms",
+    "Observed p99 latency (ms) over the model's rolling SLO window",
+    ("model", "window"),
+)
+SLO_ERROR_BURN = telemetry.gauge(
+    "gordo_server_slo_error_burn_rate",
+    "Error-budget burn rate over the window: observed 5xx fraction / "
+    "GORDO_TPU_SLO_ERROR_BUDGET (1.0 = burning exactly at budget; the "
+    "classic page threshold is 14.4 on the short window)",
+    ("model", "window"),
+)
+SLO_LATENCY_BURN = telemetry.gauge(
+    "gordo_server_slo_latency_burn_rate",
+    "Latency-objective burn rate over the window: fraction of requests "
+    "slower than GORDO_TPU_SLO_P99_MS divided by the 1 percent allowance "
+    "(>1 means the p99 objective is being missed)",
+    ("model", "window"),
+)

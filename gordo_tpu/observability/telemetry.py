@@ -417,8 +417,10 @@ def prometheus_bridge(
     are read live at scrape time, so the bridge is registered once and
     never needs refreshing. In multiprocess serving mode the bridged
     values are the scraped worker's own (process-local registry); the
-    cross-worker aggregates remain the mmap-backed prometheus_client
-    metrics (server/prometheus/metrics.py).
+    cross-worker fleet view is :mod:`.shared` (``GORDO_TPU_TELEMETRY_DIR``
+    per-pid shards merged at scrape — no prometheus_client required),
+    with the mmap-backed prometheus_client metrics
+    (server/prometheus/metrics.py) as the prometheus-native alternative.
     """
     try:
         from prometheus_client.core import (
@@ -433,6 +435,14 @@ def prometheus_bridge(
 
     class _TelemetryCollector:
         def collect(self):
+            # fleet mode: the shard merge (shared.render_fleet_text,
+            # appended to the exposition by prometheus/metrics.py) owns
+            # every telemetry family — yielding the local values here too
+            # would emit duplicate metric families in one scrape
+            from gordo_tpu.observability import shared
+
+            if shared.enabled():
+                return
             for metric in registry.collect():
                 labelnames = list(metric.labelnames)
                 if metric.kind == "counter":

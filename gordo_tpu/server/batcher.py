@@ -77,6 +77,19 @@ class _Item:
     trace_ctx: Any = None
 
 
+@functools.lru_cache(maxsize=1024)
+def _spec_forward_flops(spec) -> float:
+    """Analytic forward FLOPs per sample for the achieved-FLOPs counter
+    (device duty-cycle/MFU telemetry — observability/device.py). 0.0 when
+    the spec walk fails: accounting must never fail a device call."""
+    try:
+        from gordo_tpu.ops.flops import forward_flops_per_sample
+
+        return float(forward_flops_per_sample(spec))
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
 @functools.lru_cache(maxsize=256)
 def _stacked_apply(spec, n_pad: int, batch: int, capacity: int):
     """One compiled program per (spec, padded length, batch bucket, bank
@@ -730,9 +743,19 @@ class CrossModelBatcher:
             raise
         finally:
             self._busy_since = None
+            # duty-cycle accounting: busy-seconds accumulate whether the
+            # call succeeded or not — the device was occupied either way
+            metric_catalog.DEVICE_BUSY_SECONDS.inc(
+                max(0.0, time.monotonic() - t0)
+            )
         # recorded BEFORE fan-out (done.set): a rider resuming at its
         # event must already find the device-call span in its trace
         self._emit_device_span(items, t0)
+        # achieved FLOPs: useful lanes only (n real riders x n_pad windows
+        # each) — padding lanes are waste the MFU numerator must not claim
+        metric_catalog.DEVICE_FLOPS.inc(
+            _spec_forward_flops(spec) * float(items[0].n_pad) * n
+        )
         self.stats["items"] += n
         self.stats["device_calls"] += 1
         self.stats["largest_batch"] = max(self.stats["largest_batch"], n)
@@ -804,7 +827,13 @@ class CrossModelBatcher:
                 )
             finally:
                 self._busy_since = None
+                metric_catalog.DEVICE_BUSY_SECONDS.inc(
+                    max(0.0, time.monotonic() - t0)
+                )
                 self._emit_device_span([item], t0, rescue=True)
+            metric_catalog.DEVICE_FLOPS.inc(
+                _spec_forward_flops(spec) * float(item.n_pad)
+            )
             result = out[: item.n_keep]
             if resilience.validate_output_enabled() and not np.all(
                 np.isfinite(result)

@@ -19,8 +19,14 @@ nothing new by default):
   process is actually running with (env-set knobs verbatim, effective
   values for the serving knobs that have defaults). Values whose name
   suggests a secret are redacted.
+- ``GET /debug/slo`` — per-model rolling-window latency/error summaries
+  and burn rates against the configured objectives
+  (observability/slo.py): this process's view always, plus the merged
+  fleet view when ``GORDO_TPU_TELEMETRY_DIR`` shards are active.
 
-Everything here is read-only: no handler mutates server state.
+Everything here is read-only: no handler mutates server state (the
+telemetry-shard flush a fleet view triggers only refreshes this
+process's own shard file).
 """
 
 import os
@@ -65,6 +71,8 @@ def dispatch(endpoint: str, config: Dict[str, Any]) -> Response:
         return flight_view()
     if endpoint == "debug_vars":
         return vars_view(config)
+    if endpoint == "debug_slo":
+        return slo_view()
     return config_view()
 
 
@@ -90,6 +98,7 @@ def vars_view(config: Dict[str, Any]) -> Response:
                 series.append({"labels": labels, "value": value})
         metrics[metric.name] = {"kind": metric.kind, "series": series}
 
+    from gordo_tpu.observability import device, shared
     from gordo_tpu.server.batcher import peek_batcher
 
     batcher = peek_batcher()
@@ -104,6 +113,11 @@ def vars_view(config: Dict[str, Any]) -> Response:
                 "project": config.get("PROJECT"),
             },
             "batcher": None if batcher is None else dict(batcher.stats),
+            # duty cycle / online MFU / param-bank residency / memory
+            # (observability/device.py; refreshes the gauges it reports)
+            "device": device.snapshot(),
+            # cross-worker merged view; None without GORDO_TPU_TELEMETRY_DIR
+            "fleet": shared.fleet_vars(),
             "flight": {
                 "seen": recorder.seen,
                 "kept": recorder.kept,
@@ -111,6 +125,21 @@ def vars_view(config: Dict[str, Any]) -> Response:
             },
         }
     )
+
+
+# ----------------------------------------------------------------- /debug/slo
+def slo_view() -> Response:
+    """Per-model SLO summaries and burn rates: always this process's local
+    tracker; plus the fleet merge over every worker's shard payload when
+    telemetry shards are enabled."""
+    from gordo_tpu.observability import shared, slo
+
+    payload: Dict[str, Any] = {"local": slo.snapshot()}
+    if shared.enabled():
+        # flush first so the answering worker's own windows are in the merge
+        shared.flush(force=True)
+        payload["fleet"] = slo.merge_payloads(shared.fleet_extras("slo"))
+    return _json(payload)
 
 
 # -------------------------------------------------------------- /debug/config

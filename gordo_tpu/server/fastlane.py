@@ -56,7 +56,7 @@ except ImportError:  # pragma: no cover - environment-dependent
 
 from gordo_tpu.observability import flight, telemetry, tracing
 from gordo_tpu.server import fast_codec, resilience
-from gordo_tpu.server.server import RequestContext
+from gordo_tpu.server.server import RequestContext, observe_request_outcome
 
 logger = logging.getLogger(__name__)
 
@@ -503,6 +503,12 @@ class FastLaneServer:
             )
             if app._prometheus is not None:
                 app._prometheus.record(request, response, start)
+            # the same fleet/SLO feed the WSGI edge runs in
+            # dispatch_request — lane observability parity by construction
+            observe_request_outcome(
+                rule, gordo_name, runtime_s, response.status,
+                slo_eligible=True,
+            )
             out_headers = [("Content-Type", response.mimetype)]
             out_headers.extend(response.headers.items())
             return response.status, out_headers, response.body

@@ -250,8 +250,22 @@ def openapi_document() -> dict:
                     },
                 }
             },
+            "/debug/slo": {
+                "get": {
+                    "summary": "Per-model rolling-window SLO summaries and "
+                    "burn rates (local + fleet-merged when telemetry "
+                    "shards are on); gated by GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "{local, fleet}"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
             "/metrics": {
-                "get": {"summary": "Prometheus metrics (when enabled)",
+                "get": {"summary": "Prometheus metrics (when enabled), or "
+                        "the merged fleet exposition when telemetry shards "
+                        "are on (GORDO_TPU_TELEMETRY_DIR) — no "
+                        "prometheus_client required",
                         "responses": {"200": {"description": "text format"},
                                       "404": {"description": "disabled"}}}
             },

@@ -207,4 +207,15 @@ class GordoServerPrometheusMetrics:
         children["off"].set(off)
 
     def expose(self) -> bytes:
-        return generate_latest(self.registry)
+        out = generate_latest(self.registry)
+        # fleet mode (GORDO_TPU_TELEMETRY_DIR): the telemetry bridge stands
+        # down (telemetry.prometheus_bridge) and the shard merge supplies
+        # every telemetry family instead — fleet-summed across the prefork
+        # pool, where the bridge could only show the scraped worker
+        from gordo_tpu.observability import shared
+
+        if shared.enabled():
+            fleet = shared.render_fleet_text()
+            if fleet:
+                out += fleet.encode()
+        return out

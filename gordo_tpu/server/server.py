@@ -31,7 +31,14 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from gordo_tpu import __version__
-from gordo_tpu.observability import flight, telemetry, tracing
+from gordo_tpu.observability import (
+    flight,
+    metrics as metric_catalog,
+    shared,
+    slo,
+    telemetry,
+    tracing,
+)
 from gordo_tpu.server import resilience, views
 
 logger = logging.getLogger(__name__)
@@ -40,6 +47,30 @@ logger = logging.getLogger(__name__)
 # here and nowhere else (healthcheck/readiness/metrics must answer even on
 # a saturated server — that is what load shedding protects)
 _GATED_ENDPOINTS = ("base_prediction", "anomaly_prediction")
+
+
+def observe_request_outcome(
+    rule: str, model: str, duration_s: float, status: int,
+    slo_eligible: bool = False,
+) -> None:
+    """Per-request fleet/SLO feed, shared verbatim by the WSGI edge and the
+    socket fast lane so the two lanes produce identical observability
+    (pinned by tests/gordo_tpu/test_fastlane.py). Labels by the matched
+    RULE and the status CLASS — both bounded — and flushes this process's
+    telemetry shard (throttled) so the fleet view stays fresh under load."""
+    try:
+        status_class = f"{int(status) // 100}xx"
+        metric_catalog.FLEET_REQUESTS.labels(
+            endpoint=rule, status=status_class
+        ).inc()
+        metric_catalog.FLEET_REQUEST_SECONDS.labels(
+            endpoint=rule
+        ).observe(duration_s)
+        if slo_eligible and model:
+            slo.record(model, duration_s, status)
+        shared.flush()
+    except Exception:  # noqa: BLE001 — observability must not fail requests
+        logger.debug("request observability feed failed", exc_info=True)
 
 
 def default_config() -> Dict[str, Any]:
@@ -161,6 +192,7 @@ class GordoServer:
             Rule("/debug/flight", endpoint="debug_flight"),
             Rule("/debug/vars", endpoint="debug_vars"),
             Rule("/debug/config", endpoint="debug_config"),
+            Rule("/debug/slo", endpoint="debug_slo"),
             Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
             Rule(
                 "/gordo/v0/<gordo_project>/models",
@@ -210,6 +242,13 @@ class GordoServer:
             self.config.update(config)
         self.testing = False
         self._ready_memo: set = set()
+        # fleet observability hooks: SLO gauges + window state and the
+        # device-telemetry sampler ride every telemetry-shard flush (both
+        # idempotent; no-ops until GORDO_TPU_TELEMETRY_DIR enables shards)
+        slo.install_shard_hooks()
+        from gordo_tpu.observability import device as device_telemetry
+
+        device_telemetry.install_shard_hooks()
         self._prometheus = None
         if self.config["ENABLE_PROMETHEUS"]:
             from gordo_tpu.server.prometheus.metrics import (
@@ -367,12 +406,27 @@ class GordoServer:
                 request.method, request.path, response.status_code,
                 runtime_s,
             )
+        matched_rule = request.environ.get("gordo_tpu.rule")
+        rule = matched_rule if matched_rule is not None else request.path
+        model = request.environ.get("gordo_tpu.model", "")
         flight.default_recorder().observe(
             rtrace.collector,
             status=response.status_code,
             duration_s=runtime_s,
-            endpoint=request.environ.get("gordo_tpu.rule", request.path),
-            model=request.environ.get("gordo_tpu.model", ""),
+            endpoint=rule,
+            model=model,
+        )
+        observe_request_outcome(
+            # the raw path is fine for the bounded flight ring above, but
+            # metric labels must stay bounded: scanner probes of random
+            # URLs collapse into one series, matching the prometheus layer
+            matched_rule if matched_rule is not None else "(unmatched)",
+            model, runtime_s, response.status_code,
+            # SLO windows track the two prediction routes only (the routes
+            # a latency objective is about); the rule suffix identifies
+            # them the same way on both lanes
+            slo_eligible=bool(matched_rule)
+            and matched_rule.endswith("/prediction"),
         )
         return response
 
@@ -486,13 +540,25 @@ class GordoServer:
 
                     response = debug.dispatch(endpoint, self.config)
                 elif endpoint == "metrics":
-                    if self._prometheus is None:
-                        response = Response("metrics disabled", status=404)
-                    else:
+                    if self._prometheus is not None:
                         response = Response(
                             self._prometheus.expose(),
                             mimetype="text/plain; version=0.0.4",
                         )
+                    else:
+                        # no prometheus_client required: with a telemetry
+                        # dir configured, /metrics serves the merged fleet
+                        # view straight from the per-worker shards
+                        fleet = shared.render_fleet_text()
+                        if fleet is None:
+                            response = Response(
+                                "metrics disabled", status=404
+                            )
+                        else:
+                            response = Response(
+                                fleet,
+                                mimetype="text/plain; version=0.0.4",
+                            )
                 elif endpoint == "expected_models":
                     # the SAME resolution as /readiness (env or staged
                     # file) — the two must never disagree about the fleet
@@ -618,6 +684,14 @@ def run_server(
         )
 
     workers = max(1, workers)
+    # multi-worker pools get a telemetry shard dir by default: without it
+    # a /metrics or /debug/vars scrape answered by one worker would show
+    # that worker's numbers only (observability/shared.py). Honour an
+    # operator-provided dir; the env propagates through fork to children.
+    if workers > 1 and not shared.enabled():
+        os.environ[shared.ENV_DIR] = tempfile.mkdtemp(
+            prefix="gordo-telemetry-"
+        )
     if (
         workers > 1
         and default_config()["ENABLE_PROMETHEUS"]
@@ -807,6 +881,9 @@ def run_server(
             if reaped == pid:
                 worker_pids.discard(pid)
                 mark_worker_dead(pid)
+                # retire the dead worker's telemetry shard too, or its last
+                # counters would stay in the fleet sum forever
+                shared.mark_shard_dead(pid)
                 if shutting_down:
                     continue
                 lifetime = _time.monotonic() - spawn_times.pop(pid, 0.0)

@@ -8,12 +8,92 @@ cache's entry count and byte size at startup into the telemetry registry
 export time — entries gained during the process are cold compiles that
 future builds will skip."""
 
+import logging
 import os
+import re
 from typing import Optional, Tuple
 
 # entry count at setup, so record_cache_growth can report the delta
 _entries_at_setup: Optional[int] = None
 _cache_dir: Optional[str] = None
+
+# ---------------------------------------- cosmetic AOT-warning filter
+# XLA tuning pseudo-features: the CPU AOT loader includes them in its
+# feature fingerprint, so two processes on the SAME host can disagree on
+# exactly these and nothing else — the loader then warns ("could lead to
+# execution errors such as SIGILL") about a mismatch that cannot SIGILL.
+# The round-4 bench drowned in these. A mismatch on any *real* ISA
+# feature (avx512f, sve, ...) still warns loudly.
+_COSMETIC_FEATURES = frozenset({"prefer-no-gather", "prefer-no-scatter"})
+
+_QUOTED_RE = re.compile(r"['\"]([^'\"]*)['\"]")
+
+
+def _feature_sets(message: str):
+    """CPU-feature token sets parsed from the warning's quoted feature
+    lists (tokens split on ',', leading +/- stripped)."""
+    sets = []
+    for quoted in _QUOTED_RE.findall(message):
+        if "+" not in quoted and "," not in quoted:
+            continue
+        tokens = {
+            part.strip().lstrip("+-")
+            for part in quoted.replace("+", ",").split(",")
+            if part.strip().lstrip("+-")
+        }
+        if tokens:
+            sets.append(tokens)
+    return sets
+
+
+def is_cosmetic_aot_mismatch(message: str) -> bool:
+    """True only when the message is the AOT feature-mismatch warning AND
+    every differing feature is a cosmetic tuning pseudo-feature. Parsing
+    failure means False — unknown mismatches stay loud."""
+    if "SIGILL" not in message and "execution errors" not in message:
+        return False
+    sets = _feature_sets(message)
+    if len(sets) < 2:
+        return False
+    diff = sets[0] ^ sets[1]
+    return bool(diff) and diff <= _COSMETIC_FEATURES
+
+
+class CosmeticAotMismatchFilter(logging.Filter):
+    """Drops the known-cosmetic ``+prefer-no-gather``/``+prefer-no-scatter``
+    AOT loader warning at the logging layer; any genuine feature mismatch
+    passes through untouched (pinned by tests/gordo_tpu/test_xla_cache.py).
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            message = record.getMessage()
+        except Exception:  # noqa: BLE001 — never break logging itself
+            return True
+        return not is_cosmetic_aot_mismatch(message)
+
+
+_AOT_FILTER = CosmeticAotMismatchFilter()
+
+# loggers the XLA:CPU AOT loader warning can surface through (direct jax
+# loggers plus warnings-module capture); filters don't propagate, so the
+# filter is attached to each
+_AOT_LOGGER_NAMES = (
+    "jax",
+    "jax._src.compiler",
+    "jax._src.compilation_cache",
+    "jax._src.cache_key",
+    "py.warnings",
+)
+
+
+def install_aot_warning_filter() -> None:
+    """Attach the cosmetic-mismatch filter to the jax loggers (idempotent:
+    logging.Logger.addFilter is a no-op for an already-attached filter)."""
+    for name in _AOT_LOGGER_NAMES:
+        logging.getLogger(name).addFilter(_AOT_FILTER)
+    for handler in logging.getLogger().handlers:
+        handler.addFilter(_AOT_FILTER)
 
 
 def cache_stats(cache_dir: str) -> Tuple[int, int]:
@@ -102,6 +182,10 @@ def setup_persistent_xla_cache(min_compile_secs: float = 1.0) -> str:
     global _entries_at_setup, _cache_dir
     import jax
 
+    # every persistent-cache user is a potential AOT-artifact loader, so
+    # the cosmetic feature-mismatch warning is silenced here (genuine ISA
+    # mismatches still pass the filter and stay loud)
+    install_aot_warning_filter()
     cache_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
         "/tmp/gordo_tpu_xla_cache-"

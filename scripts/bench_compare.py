@@ -59,6 +59,11 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # records, so it only gates once both sides of a pair carry it
     ("server_load_fastlane_req_per_sec", True),
     ("server_load_fastlane_p99_ms", False),
+    # fleet-plane merged view of the same load (ISSUE 9): the merged p99
+    # gates like the harness-side p99; the burn rates are ratios where
+    # lower is better (burn 1.0 = consuming budget exactly as allowed)
+    ("server_fleet_p99_ms", False),
+    ("server_fleet_latency_burn_rate", False),
 )
 
 # which harness section feeds each metric (schema v2 records carry a
@@ -74,7 +79,7 @@ def metric_section(key: str, parsed: dict) -> Optional[str]:
         return "headline"
     if key in _SERVING_METRICS:
         return parsed.get("serving_source")
-    if key.startswith("server_load_"):
+    if key.startswith(("server_load_", "server_fleet_")):
         return "serving_load"
     return None
 

@@ -44,6 +44,11 @@ _NUMERIC_KEYS = (
     # the socket fast lane's arm of the serving_load section (ISSUE 7)
     "server_load_fastlane_req_per_sec", "server_load_fastlane_p50_ms",
     "server_load_fastlane_p99_ms",
+    # the fleet observability plane's merged view of the load (ISSUE 9);
+    # peak_source rides alongside but is a string tag, not a number
+    "server_fleet_workers", "server_fleet_requests_total",
+    "server_fleet_p99_ms", "server_fleet_error_burn_rate",
+    "server_fleet_latency_burn_rate",
 )
 
 

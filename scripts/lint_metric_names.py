@@ -23,6 +23,13 @@ Three checks:
    doc page or generated dashboard. A metric nothing documents or plots
    is invisible at exactly the moment an operator needs it — the same
    rule lint_env_knobs.py enforces for env knobs.
+4. **Dashboard grounding** (``--dashboards``) — the reverse direction:
+   every ``gordo_*`` metric a Grafana dashboard panel expr references
+   must exist in a metrics catalog (the telemetry catalog plus the
+   prometheus_client metrics module). A dashboard plotting a renamed or
+   deleted metric renders an empty panel silently — at exactly the
+   moment an operator stares at it. ``_bucket``/``_sum``/``_count``
+   suffixes resolve to their histogram family.
 
 Checked call shapes: any call to ``Counter``/``Gauge``/``Histogram``
 (prometheus_client or telemetry classes) or the telemetry factory
@@ -32,17 +39,22 @@ registry's own internals) are skipped — the registry validates help text
 at runtime.
 
 Usage: ``python scripts/lint_metric_names.py [root ...]
-[--catalog PATH --refs PATH ...]`` (default roots: ``gordo_tpu``; with
-default roots the catalog check runs against
+[--catalog PATH --refs PATH ...]
+[--dashboards DIR --dashboard-catalogs PATH ...]`` (default roots:
+``gordo_tpu``; with default roots the catalog check runs against
 ``gordo_tpu/observability/metrics.py`` vs ``docs`` +
-``gordo_tpu/observability/grafana.py`` + ``README.md``). Exit 0 = clean,
-1 = violations (printed one per line), 2 = a file failed to parse.
-Wired into tier-1 via tests/gordo_tpu/test_lint.py.
+``gordo_tpu/observability/grafana.py`` + ``README.md``, and the
+dashboard grounding check runs over ``resources/grafana/dashboards``).
+Exit 0 = clean, 1 = violations (printed one per line), 2 = a file failed
+to parse. Wired into tier-1 via tests/gordo_tpu/test_lint.py and the
+``make lint-dashboards`` target.
 """
 
 import argparse
 import ast
+import json
 import pathlib
+import re
 import sys
 from typing import List, Optional
 
@@ -65,6 +77,19 @@ _DEFAULT_REFS = (
     "gordo_tpu/observability/grafana.py",
     "README.md",
 )
+
+# dashboard grounding: where the generated dashboards live, and every
+# module that legitimately mints gordo_* metric names (the telemetry
+# catalog plus the prometheus_client request metrics)
+_DEFAULT_DASHBOARD_DIR = "resources/grafana/dashboards"
+_DEFAULT_DASHBOARD_CATALOGS = (
+    "gordo_tpu/observability/metrics.py",
+    "gordo_tpu/server/prometheus/metrics.py",
+)
+
+_METRIC_REF_RE = re.compile(r"\bgordo_[a-z0-9_]+")
+# exposition suffixes a histogram family answers for in PromQL
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 def _call_name(node: ast.Call) -> Optional[str]:
@@ -179,6 +204,64 @@ def find_unreferenced(catalog: str, refs: List[str]) -> List[str]:
     return violations
 
 
+def _panel_exprs(obj):
+    """Every ``expr`` string anywhere in a dashboard JSON document."""
+    if isinstance(obj, dict):
+        for key, value in obj.items():
+            if key == "expr" and isinstance(value, str):
+                yield value
+            else:
+                yield from _panel_exprs(value)
+    elif isinstance(obj, list):
+        for item in obj:
+            yield from _panel_exprs(item)
+
+
+def _strip_label_contexts(expr: str) -> str:
+    """Remove the expr positions where a gordo_*-shaped token is a LABEL
+    (selector bodies, by/without groupings, label_values' label argument),
+    so only metric-name positions are scanned."""
+    expr = re.sub(r"\{[^}]*\}", "", expr)
+    expr = re.sub(r"\b(?:by|without)\s*\([^)]*\)", "", expr)
+    expr = re.sub(r"\blabel_values\(([^,()]*),[^)]*\)", r"\1", expr)
+    return expr
+
+
+def find_unknown_dashboard_metrics(
+    dashboard_dir: str, catalogs: List[str]
+) -> List[str]:
+    """Dashboard panel exprs referencing gordo_* names no catalog defines."""
+    known = set()
+    for catalog in catalogs:
+        for _node, name in _metric_calls(pathlib.Path(catalog)):
+            known.add(name)
+    violations = []
+    for path in sorted(pathlib.Path(dashboard_dir).rglob("*.json")):
+        try:
+            document = json.loads(path.read_text(errors="replace"))
+        except ValueError as exc:
+            violations.append(f"{path}: unparseable dashboard JSON ({exc})")
+            continue
+        unknown = set()
+        for expr in _panel_exprs(document):
+            for ref in _METRIC_REF_RE.findall(_strip_label_contexts(expr)):
+                if ref in known:
+                    continue
+                if any(
+                    ref.endswith(suffix) and ref[: -len(suffix)] in known
+                    for suffix in _HISTOGRAM_SUFFIXES
+                ):
+                    continue
+                unknown.add(ref)
+        for ref in sorted(unknown):
+            violations.append(
+                f"{path}: panel expr references {ref!r}, which no metrics "
+                f"catalog ({', '.join(catalogs)}) defines — the panel "
+                f"would render empty"
+            )
+    return violations
+
+
 def main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("roots", nargs="*", default=[])
@@ -193,15 +276,36 @@ def main(argv: List[str]) -> int:
         default=None,
         help="doc/dashboard roots the catalog metrics must appear in",
     )
+    parser.add_argument(
+        "--dashboards",
+        default=None,
+        help="dashboard JSON dir whose panel exprs must reference only "
+        "cataloged metrics",
+    )
+    parser.add_argument(
+        "--dashboard-catalogs",
+        nargs="*",
+        default=None,
+        help="modules whose metric registrations ground the dashboard "
+        "check",
+    )
     args = parser.parse_args(argv)
     roots = args.roots or ["gordo_tpu"]
     catalog = args.catalog
     refs = args.refs
-    if catalog is None and not args.roots:
-        # default invocation lints the real tree: catalog coverage included
-        catalog = _DEFAULT_CATALOG
+    dashboards = args.dashboards
+    if not args.roots:
+        # default invocation lints the real tree: catalog coverage and
+        # dashboard grounding included
+        if catalog is None:
+            catalog = _DEFAULT_CATALOG
+        if dashboards is None:
+            dashboards = _DEFAULT_DASHBOARD_DIR
     if catalog is not None and refs is None:
         refs = list(_DEFAULT_REFS)
+    dashboard_catalogs = args.dashboard_catalogs or list(
+        _DEFAULT_DASHBOARD_CATALOGS
+    )
 
     violations = []
     try:
@@ -209,6 +313,10 @@ def main(argv: List[str]) -> int:
             violations.extend(find_bad_metrics(root))
         if catalog is not None:
             violations.extend(find_unreferenced(catalog, refs))
+        if dashboards is not None:
+            violations.extend(
+                find_unknown_dashboard_metrics(dashboards, dashboard_catalogs)
+            )
     except SyntaxError as exc:
         print(f"parse error: {exc}", file=sys.stderr)
         return 2

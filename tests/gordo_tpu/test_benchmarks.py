@@ -477,13 +477,13 @@ def test_load_test_flight_gated_off_degrades(live_server, gordo_project,
     assert "GORDO_TPU_DEBUG_ENDPOINTS" in report["flight"]["reason"]
 
 
-def test_bench_serving_load_section(monkeypatch):
+def test_bench_serving_load_section(monkeypatch, tmp_path):
     """The bench harness's serving_load section end-to-end (tiny knobs):
     builds a model, serves it over real HTTP, drives the open-loop load
-    generator, and returns QPS + ramp reports with tail percentiles and
-    flight-recorded worst requests."""
+    generator, and returns QPS + ramp reports with tail percentiles,
+    flight-recorded worst requests, and the merged fleet-plane summary."""
     import bench
-    from gordo_tpu.observability import flight
+    from gordo_tpu.observability import flight, shared, slo
 
     monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
     monkeypatch.setenv("GORDO_TPU_FLIGHT_SLOW_S", "0.0001")
@@ -492,12 +492,28 @@ def test_bench_serving_load_section(monkeypatch):
     monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_SECONDS", "1.5")
     monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_WARMUP_S", "0.3")
     monkeypatch.setenv("GORDO_TPU_BENCH_LOAD_USERS", "2")
+    # every env knob the section would os.environ.setdefault must be
+    # monkeypatched here, or the setdefault leaks into the test process
+    # (the telemetry dir would flip later tests' /metrics into fleet mode)
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY_DIR", str(tmp_path))
     monkeypatch.setattr(bench, "EPOCHS", 1)  # one-epoch model build
     flight.reset()
+    shared.reset_for_tests()
+    slo.reset()
     try:
         result = bench._bench_serving_load()
     finally:
         flight.reset()
+        shared.reset_for_tests()
+        slo.reset()
+    # fleet-plane summary (ISSUE 9): the one-worker fleet's census and the
+    # model's merged 5m SLO window, travelled through the full shard path
+    fleet = result["fleet"]
+    assert "error" not in fleet, fleet
+    assert fleet["workers"] == 1
+    assert fleet["requests_total"] > 0
+    assert fleet["p99_ms"] is not None and fleet["p99_ms"] > 0
+    assert fleet["latency_burn_rate"] is not None
     qps = result["qps"]
     assert qps["requests"] > 0 and qps["mode"] == "qps"
     assert qps["p999_ms"] >= qps["p50_ms"] > 0

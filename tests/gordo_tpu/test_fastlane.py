@@ -558,6 +558,75 @@ def test_fast_lane_with_batcher(
     assert batcher_mod._batcher.stats["items"] >= 5
 
 
+# ------------------------------------------------- observability parity
+def test_observability_parity_between_lanes(
+    wsgi_client, fast_server, gordo_project, gordo_name, X_payload
+):
+    """ISSUE 9: both lanes feed the SAME request-outcome observability —
+    one request down each lane produces identical fleet-counter deltas
+    (same endpoint rule, same status class) and identical per-model SLO
+    sample counts. Lane choice must never skew SLO accounting."""
+    from gordo_tpu.observability import slo
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    body = json.dumps({"X": X_payload.values.tolist()}).encode()
+    headers = {"Content-Type": "application/json"}
+
+    def counter_values():
+        return dict(metric_catalog.FLEET_REQUESTS.snapshot())
+
+    def histogram_counts():
+        return {
+            key: sum(counts)
+            for key, (counts, _total) in
+            metric_catalog.FLEET_REQUEST_SECONDS.snapshot()
+        }
+
+    def one_request(send):
+        slo.reset()
+        counters_before = counter_values()
+        hist_before = histogram_counts()
+        send()
+        counter_delta = {
+            key: value - counters_before.get(key, 0)
+            for key, value in counter_values().items()
+            if value != counters_before.get(key, 0)
+        }
+        hist_delta = {
+            key: value - hist_before.get(key, 0)
+            for key, value in histogram_counts().items()
+            if value != hist_before.get(key, 0)
+        }
+        slo_counts = {
+            model: {w: s["requests"] for w, s in windows.items()}
+            for model, windows in slo.snapshot()["models"].items()
+        }
+        return counter_delta, hist_delta, slo_counts
+
+    def fast():
+        status, _, _ = _fast_request(
+            fast_server, "POST", path, body=body, headers=headers
+        )
+        assert status == 200
+
+    def wsgi():
+        resp = wsgi_client.post(path, data=body, headers=list(headers.items()))
+        assert resp.status_code == 200
+
+    fast_counters, fast_hist, fast_slo = one_request(fast)
+    wsgi_counters, wsgi_hist, wsgi_slo = one_request(wsgi)
+    # exactly one 2xx outcome on the same endpoint rule, both lanes
+    assert fast_counters == wsgi_counters
+    assert len(fast_counters) == 1
+    ((rule, status_class),) = fast_counters
+    assert rule.endswith("/prediction")
+    assert status_class == "2xx"
+    assert fast_hist == wsgi_hist
+    # one SLO sample for the model, in both rolling windows, both lanes
+    assert fast_slo == wsgi_slo == {gordo_name: {"5m": 1, "1h": 1}}
+
+
 # -------------------------------------------------------- tier-1 perf smoke
 def test_fast_lane_load_smoke(fast_server, gordo_project, gordo_name):
     """Satellite: the fast lane survives the real open-loop load generator

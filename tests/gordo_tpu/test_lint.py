@@ -16,6 +16,11 @@ Tier-1 lint gates.
   contract (scripts/lint_bench_record.py): all canonical sections
   present with an explicit status, summary metrics number-or-null —
   the round-4/5 "bench ran, record useless" postmortems made checkable.
+- Every ``gordo_*`` metric a generated Grafana dashboard plots exists in
+  a metrics catalog (lint_metric_names.py --dashboards): a panel keyed
+  on a renamed metric renders empty silently. Plus a tiny-budget fleet
+  scrape smoke holding the merged /metrics exposition to the same
+  naming bar.
 """
 
 import json
@@ -298,7 +303,8 @@ def test_bench_record_lint_legacy_skip_and_strict(tmp_path):
 
 def test_metric_lint_default_invocation_checks_real_catalog():
     """The bare invocation (what tier-1 runs) includes catalog coverage
-    of observability/metrics.py against docs + dashboards."""
+    of observability/metrics.py against docs + dashboards AND the reverse
+    dashboard-grounding check over resources/grafana/dashboards."""
     result = subprocess.run(
         [sys.executable, str(METRIC_LINT)],
         cwd=str(REPO_ROOT),
@@ -309,3 +315,88 @@ def test_metric_lint_default_invocation_checks_real_catalog():
         f"metric catalog drifted from docs/dashboards:\n"
         f"{result.stdout}{result.stderr}"
     )
+
+
+# ------------------------------------------------ dashboard grounding
+def _dashboard_fixture(tmp_path, exprs):
+    """A minimal dashboard JSON + a catalog registering two metrics."""
+    catalog = tmp_path / "catalog.py"
+    catalog.write_text(
+        "from gordo_tpu.observability import telemetry\n"
+        'a = telemetry.counter("gordo_real_total", "a real counter")\n'
+        'b = telemetry.histogram("gordo_real_seconds", "a real histogram")\n'
+    )
+    dashboards = tmp_path / "dashboards"
+    dashboards.mkdir()
+    (dashboards / "dash.json").write_text(json.dumps({
+        "panels": [
+            {"targets": [{"expr": expr} for expr in exprs]},
+        ],
+    }))
+    return dashboards, catalog
+
+
+def _run_dashboard_lint(tmp_path, dashboards, catalog):
+    # an explicit (empty-of-offenders) root keeps the default-tree catalog
+    # checks out of the way; only the dashboard grounding is under test
+    return subprocess.run(
+        [
+            sys.executable, str(METRIC_LINT), str(tmp_path / "dashboards"),
+            "--dashboards", str(dashboards),
+            "--dashboard-catalogs", str(catalog),
+        ],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_dashboard_lint_flags_uncataloged_metric(tmp_path):
+    dashboards, catalog = _dashboard_fixture(tmp_path, [
+        'rate(gordo_real_total[5m])',
+        'sum(rate(gordo_ghost_total[5m]))',  # nothing registers this
+    ])
+    result = _run_dashboard_lint(tmp_path, dashboards, catalog)
+    assert result.returncode == 1
+    assert "gordo_ghost_total" in result.stdout
+    assert "render empty" in result.stdout
+    assert "gordo_real_total" not in result.stdout
+
+
+def test_dashboard_lint_accepts_cataloged_and_label_positions(tmp_path):
+    dashboards, catalog = _dashboard_fixture(tmp_path, [
+        # histogram suffixes resolve to the base family; gordo_*-shaped
+        # tokens in label positions (selector bodies, by-clauses) are
+        # labels, not metric references
+        'histogram_quantile(0.99, sum by (le, gordo_name) '
+        '(rate(gordo_real_seconds_bucket{gordo_name="m"}[5m])))',
+        'sum(gordo_real_total{gordo_project=~"$project"})',
+    ])
+    result = _run_dashboard_lint(tmp_path, dashboards, catalog)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_fleet_scrape_smoke(tmp_path, monkeypatch):
+    """Tiny-budget fleet-scrape smoke: flush this process's shard, render
+    the merged exposition (the exact bytes a no-prometheus /metrics
+    serves), and hold every exposed family to the lint's naming bar."""
+    import re
+
+    from gordo_tpu.observability import shared, telemetry
+
+    monkeypatch.setenv(shared.ENV_DIR, str(tmp_path))
+    shared.reset_for_tests()
+    try:
+        telemetry.counter(
+            "gordo_server_lint_smoke_total", "scrape-smoke probe"
+        ).inc()
+        text = shared.render_fleet_text()
+        assert "gordo_server_fleet_workers 1" in text
+        assert "gordo_server_lint_smoke_total 1" in text
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name = re.split(r"[{\s]", line, maxsplit=1)[0]
+            assert name.startswith("gordo_"), line
+    finally:
+        shared.reset_for_tests()

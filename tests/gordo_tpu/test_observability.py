@@ -5,6 +5,7 @@ import re
 
 from gordo_tpu.observability import (
     build_dashboard,
+    fleet_dashboard,
     machines_dashboard,
     resilience_dashboard,
     servers_dashboard,
@@ -19,6 +20,7 @@ _ALL_DASHBOARDS = (
     machines_dashboard,
     build_dashboard,
     resilience_dashboard,
+    fleet_dashboard,
 )
 
 
@@ -52,7 +54,9 @@ def test_dashboards_reference_live_metric_names():
     exported |= set(telemetry.default_registry().names())
 
     suffix = r"(?:_bucket|_count|_sum)?"
-    metric_re = re.compile(r"(gordo_(?:server|build)_[a-z_]+?)" + suffix + r"[{\[\s)]")
+    metric_re = re.compile(
+        r"(gordo_(?:server|build)_[a-z0-9_]+?)" + suffix + r"[{\[\s)]"
+    )
     for dashboard in _ALL_DASHBOARDS:
         for expr in _all_exprs(dashboard()):
             names = metric_re.findall(expr)
@@ -89,7 +93,7 @@ def test_latency_panels_use_quantiles_not_averages():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 4
+    assert len(paths) == 5
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
@@ -109,6 +113,7 @@ def test_checked_in_dashboards_are_current():
         ("gordo_tpu_machines.json", machines_dashboard),
         ("gordo_tpu_build.json", build_dashboard),
         ("gordo_tpu_resilience.json", resilience_dashboard),
+        ("gordo_tpu_fleet.json", fleet_dashboard),
     ):
         with open(os.path.join(out_dir, name)) as fh:
             assert json.load(fh) == build(), f"{name} is stale — regenerate with " \

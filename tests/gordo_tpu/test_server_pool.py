@@ -81,6 +81,28 @@ def server_pool_fastlane(
     )
 
 
+@pytest.fixture()
+def server_pool_fleet(
+    model_collection_directory, trained_model_directories, tmp_path
+):
+    """3-worker pool with telemetry shards on an operator-provided dir and
+    prometheus DISABLED (the default config): /metrics must serve the
+    merged fleet exposition with no prometheus_client in the loop."""
+    telemetry_dir = tmp_path / "telemetry"
+    telemetry_dir.mkdir()
+    yield from _pool(
+        model_collection_directory, tmp_path,
+        extra_env={
+            "GORDO_TPU_TELEMETRY_DIR": str(telemetry_dir),
+            # flush every request: the scrape assertions below must see
+            # the last request's increments without waiting out the
+            # 0.25s write throttle
+            "GORDO_TPU_TELEMETRY_FLUSH_S": "0",
+            "GORDO_TPU_DEBUG_ENDPOINTS": "1",
+        },
+    )
+
+
 def _pool(model_collection_directory, tmp_path, extra_env=None):
     port = _free_port()
     env = {
@@ -225,6 +247,87 @@ def test_pool_fast_lane_serves_hot_and_fallback_routes(
     assert _wait_for(
         lambda: _post_json(url, payload, timeout=30)[0] == 200, timeout=60
     ), "fast-lane pool stopped serving after a worker SIGKILL"
+
+
+def test_pool_metrics_serve_fleet_sums_without_prometheus(
+    server_pool_fleet, gordo_project, gordo_name, X_payload
+):
+    """ISSUE 9 acceptance drive: a 3-worker prefork pool with
+    GORDO_TPU_TELEMETRY_DIR set and prometheus disabled answers /metrics
+    with the FLEET-SUMMED counters and merged histograms — whichever
+    worker takes the scrape, the prediction total equals the requests
+    actually sent, and /debug/slo reports the merged per-model burn
+    rates."""
+    import re
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    proc, base, errlog = server_pool_fleet
+    url = f"{base}/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction"
+    frame = dataframe_to_dict(X_payload)
+    payload = {"X": frame, "y": frame}
+
+    n_requests = 4
+    for _ in range(n_requests):
+        status, _body = _post_json(url, payload)
+        assert status == 200
+
+    series_re = re.compile(
+        r"^gordo_server_fleet_requests_total\{([^}]*)\}\s+([0-9.eE+-]+)$",
+        re.MULTILINE,
+    )
+    count_re = re.compile(
+        r"^gordo_server_fleet_request_seconds_count\{([^}]*)\}"
+        r"\s+([0-9.eE+-]+)$",
+        re.MULTILINE,
+    )
+
+    def _prediction_sum(pattern, text):
+        # sum across workers AND status/endpoint series: the scrape may be
+        # answered by any worker, but the merge must account for every
+        # prediction the pool served regardless of which worker took it
+        return sum(
+            float(value)
+            for labels, value in pattern.findall(text)
+            if "prediction" in labels
+        )
+
+    def _scrape():
+        status, body = _get(f"{base}/metrics", timeout=10)
+        assert status == 200
+        return body.decode()
+
+    # the observability feed runs as the response goes out; poll the scrape
+    # until every prediction has landed in some worker's shard
+    assert _wait_for(
+        lambda: _prediction_sum(series_re, _scrape()) >= n_requests,
+        timeout=30,
+    ), f"fleet counter never reached {n_requests}: {_scrape()[:2000]}"
+
+    text = _scrape()
+    # dependency-free Prometheus exposition, not prometheus_client output
+    assert "# TYPE gordo_server_fleet_requests_total counter" in text
+    assert "# TYPE gordo_server_fleet_workers gauge" in text
+    assert _prediction_sum(series_re, text) == n_requests
+    # merged histogram: element-wise sum across shards — the prediction
+    # count equals the counter total even when workers split the traffic
+    assert _prediction_sum(count_re, text) == n_requests
+    workers_match = re.search(
+        r"^gordo_server_fleet_workers\s+([0-9.]+)$", text, re.MULTILINE
+    )
+    assert workers_match, text[:2000]
+    assert 1 <= float(workers_match.group(1)) <= 3
+
+    # /debug/slo: the merged per-model view over the same shards
+    status, body = _get(f"{base}/debug/slo", timeout=10)
+    assert status == 200
+    fleet = json.loads(body)["fleet"]
+    window = fleet["models"][gordo_name]["5m"]
+    assert window["requests"] == n_requests
+    assert window["errors"] == 0
+    assert window["p99_ms"] is not None
+    assert window["error_burn_rate"] == 0.0
+    assert window["latency_burn_rate"] is not None
 
 
 def test_boot_failure_during_slow_warmup_trips_throttle(tmp_path):

@@ -1,14 +1,24 @@
 """Persistent XLA compile-cache keying (round-4 verdict item 3): the cache
 dir must be partitioned by host machine features, not just platform tag, so
-AOT artifacts from another host are never offered to this one."""
+AOT artifacts from another host are never offered to this one. Plus the
+cosmetic AOT-warning filter (ISSUE 9): the known-harmless
+``+prefer-no-gather``/``+prefer-no-scatter`` mismatch is silenced at the
+logging layer, while any genuine ISA mismatch still warns."""
 
+import logging
 import os
 from unittest import mock
 
 import jax
 import pytest
 
-from gordo_tpu.util.xla_cache import host_fingerprint, setup_persistent_xla_cache
+from gordo_tpu.util.xla_cache import (
+    CosmeticAotMismatchFilter,
+    host_fingerprint,
+    install_aot_warning_filter,
+    is_cosmetic_aot_mismatch,
+    setup_persistent_xla_cache,
+)
 
 
 @pytest.fixture(autouse=True)
@@ -37,3 +47,78 @@ def test_explicit_env_dir_wins():
         os.environ, {"JAX_COMPILATION_CACHE_DIR": "/tmp/explicit-cache"}
     ):
         assert setup_persistent_xla_cache() == "/tmp/explicit-cache"
+
+
+# ------------------------------------------- cosmetic AOT-warning filter
+_COSMETIC_MSG = (
+    "The loaded executable was compiled with CPU features "
+    "'+avx2,+fma,+prefer-no-gather,+prefer-no-scatter' but the host "
+    "supports '+avx2,+fma'; this discrepancy could lead to execution "
+    "errors such as SIGILL."
+)
+_GENUINE_MSG = (
+    "The loaded executable was compiled with CPU features "
+    "'+avx2,+avx512f,+prefer-no-gather' but the host supports "
+    "'+avx2,+prefer-no-gather'; this discrepancy could lead to execution "
+    "errors such as SIGILL."
+)
+
+
+def _warning_record(message: str) -> logging.LogRecord:
+    return logging.LogRecord(
+        "jax._src.compiler", logging.WARNING, __file__, 1, message, None, None
+    )
+
+
+def test_cosmetic_mismatch_detected():
+    assert is_cosmetic_aot_mismatch(_COSMETIC_MSG)
+
+
+def test_genuine_isa_mismatch_stays_loud():
+    # one differing feature is real (avx512f): must NOT be classified
+    # cosmetic even though a cosmetic pseudo-feature appears in both lists
+    assert not is_cosmetic_aot_mismatch(_GENUINE_MSG)
+    assert CosmeticAotMismatchFilter().filter(_warning_record(_GENUINE_MSG))
+
+
+def test_filter_drops_only_the_cosmetic_warning():
+    flt = CosmeticAotMismatchFilter()
+    assert not flt.filter(_warning_record(_COSMETIC_MSG))
+    assert flt.filter(_warning_record("unrelated warning about SIGILL"))
+    assert flt.filter(_warning_record("ordinary log line"))
+
+
+def test_unparseable_feature_lists_stay_loud():
+    # parse failure must never silence: no quoted feature lists here
+    message = "execution errors such as SIGILL may occur"
+    assert not is_cosmetic_aot_mismatch(message)
+
+
+def test_identical_feature_lists_not_classified_cosmetic():
+    # empty symmetric diff means this is not the mismatch warning shape
+    message = (
+        "features '+avx2,+prefer-no-gather' vs '+avx2,+prefer-no-gather' "
+        "could lead to execution errors such as SIGILL"
+    )
+    assert not is_cosmetic_aot_mismatch(message)
+
+
+def test_install_is_idempotent_and_attached():
+    install_aot_warning_filter()
+    install_aot_warning_filter()
+    jax_logger = logging.getLogger("jax._src.compiler")
+    cosmetic_filters = [
+        f for f in jax_logger.filters
+        if isinstance(f, CosmeticAotMismatchFilter)
+    ]
+    assert len(cosmetic_filters) == 1
+
+
+def test_setup_installs_the_filter():
+    with mock.patch.dict(os.environ, {}, clear=False):
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        setup_persistent_xla_cache()
+    assert any(
+        isinstance(f, CosmeticAotMismatchFilter)
+        for f in logging.getLogger("jax").filters
+    )
