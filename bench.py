@@ -863,11 +863,19 @@ def _rerun_improves(rerun: dict, original: dict) -> bool:
 # budget pressure can't cost the round its tail-latency record.
 SECTION_NAMES = (
     "tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+    "fleet_build",
 )
 SECTION_STATUSES = (
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",
 )
-RECORD_SCHEMA_VERSION = 2
+RECORD_SCHEMA_VERSION = 3
+# Older records stay valid against the section list of THEIR schema
+# version (the record lint looks the version up here): a v2 record has no
+# fleet_build section and must not start failing when v3 adds one.
+SECTION_NAMES_BY_VERSION = {
+    2: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"),
+    3: SECTION_NAMES,
+}
 
 
 def _section_status(entry: dict) -> str:
@@ -898,6 +906,7 @@ _SECTION_MIN_USEFUL = {
     "headline": 600,
     "windowed": 600,
     "batch_ab": 300,
+    "fleet_build": 240,
 }
 
 
@@ -930,6 +939,13 @@ def _section_timeout(name: str) -> int:
         # three drives (direct/batched/auto) x two archs, plus the probe
         # retry budget when the tunnel is wedged
         timeout = max(timeout, 3000)
+    if (
+        name == "fleet_build"
+        and "BENCH_SECTION_TIMEOUT_FLEET_BUILD" not in os.environ
+    ):
+        # two 2-worker arms over a small skewed fleet (CPU workers by
+        # construction) — bounded so it can never starve the fleet sections
+        timeout = min(timeout, 1500)
     if name == "windowed" and "BENCH_SECTION_TIMEOUT_WINDOWED" not in os.environ:
         # four families (LSTM AE/forecast, Transformer, TCN), each with a
         # fleet compile + steady-state build + a torch mirror — a CPU
@@ -1323,6 +1339,223 @@ def _bench_tpu_smoke() -> dict:
     return out
 
 
+_FLEET_BUILD_WORKER = """
+import json, os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+import yaml
+from gordo_tpu.machine import Machine
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.parallel import BatchedModelBuilder
+
+rank = int(sys.argv[1])
+outdir = sys.argv[2]
+policy = sys.argv[3]
+
+with open(os.path.join(outdir, "config.yaml")) as f:
+    config = yaml.safe_load(f)
+machines = [
+    Machine.from_config(c, project_name="fleet-bench")
+    for c in config["machines"]
+]
+t0 = time.time()
+builder = BatchedModelBuilder(
+    machines,
+    output_dir=os.path.join(outdir, "models"),
+    warm_start=False,
+    elastic=True,
+    scheduler_policy=policy,
+    host_rank=rank,
+    num_hosts=2,
+)
+results = builder.build()
+print("FLEET " + json.dumps({{
+    "rank": rank,
+    "wall_sec": round(time.time() - t0, 3),
+    "built": len(results),
+    "stats": dict(builder.scheduler.stats),
+    "compile_seconds_saved": metric_catalog.COMPILE_SECONDS_SAVED.value(),
+}}), flush=True)
+"""
+
+
+def _fleet_build_fleet(
+    n_buckets: int, per_bucket: int, n_split: int, chunk: int
+) -> dict:
+    """A fleet that exhibits BOTH pathologies of the static hash partition
+    (membership names are salted until each chunk-granular unit's crc32
+    owner lands where the scenario wants it):
+
+    - ``n_split`` buckets have their units SPLIT across the two hosts —
+      the affinity-blind hash scattering one compiled shape onto both
+      hosts, so the static arm pays that shape's compile twice (the
+      duplicate work compile-reuse-aware placement exists to avoid);
+    - every other bucket lands wholly on host 0 — the ~80/20 load
+      imbalance work-stealing exists to erase.
+
+    Each bucket gets a distinct train window (distinct row count ->
+    distinct compiled shape), and its chunk groups mirror the builder's
+    unit splitting under ``chunk`` machines/unit."""
+    import zlib
+
+    from gordo_tpu.parallel.scheduler import unit_id_for
+
+    def owners(names):
+        # the builder groups bucket members in machine-index order into
+        # chunk-sized units; reproduce that split to place each unit
+        return tuple(
+            zlib.crc32(
+                unit_id_for(sorted(names[start:start + chunk])).encode()
+            ) % 2
+            for start in range(0, len(names), chunk)
+        )
+
+    machines = []
+    units_per_bucket = (per_bucket + chunk - 1) // chunk
+    for j in range(n_buckets):
+        if j < n_split:
+            target = tuple(k % 2 for k in range(units_per_bucket))
+        else:
+            target = (0,) * units_per_bucket
+        salt = 0
+        while True:
+            names = [f"fb-{j}-{salt}-{k}" for k in range(per_bucket)]
+            if owners(names) == target:
+                break
+            salt += 1
+        for name in names:
+            machines.append(
+                {
+                    "name": name,
+                    "dataset": {
+                        "type": "RandomDataset",
+                        "train_start_date": "2019-01-01T00:00:00+00:00",
+                        "train_end_date": f"2019-01-02T{j:02d}:00:00+00:00",
+                        "tags": [f"{name}-a", f"{name}-b"],
+                    },
+                    "model": {
+                        "gordo_tpu.models.anomaly.diff."
+                        "DiffBasedAnomalyDetector": {
+                            "base_estimator": {
+                                "gordo_tpu.models.models.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 1,
+                                }
+                            }
+                        }
+                    },
+                }
+            )
+    return {"machines": machines}
+
+
+def _bench_fleet_build() -> dict:
+    """The elastic scheduler's A/B (ISSUE 10): the same skewed fleet built
+    by 2 worker hosts under ``scheduler_policy="static"`` (each host locked
+    to its nominal share — the partition being replaced) and under
+    ``"elastic"`` (work-stealing queue). Workers are separate single-process
+    jax CPU processes by construction — two hosts cannot share one
+    accelerator, and the section measures scheduling, not device throughput.
+    The elastic win has two components: work-stealing erases the 80/20
+    makespan imbalance (dominant on multi-core boxes, where the two workers
+    really run in parallel) and compile-reuse-aware placement keeps
+    same-shaped units on one host so the fleet compiles each program once
+    (dominant on single-core CI boxes, where makespan is total work and
+    only doing *less* of it helps). Reported: elastic fleet throughput,
+    elastic/static wall speedup, steals, and compile seconds saved by
+    program reuse within leased units."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    n_buckets = int(os.environ.get("BENCH_FLEET_BUCKETS", "10"))
+    per_bucket = int(os.environ.get("BENCH_FLEET_MACHINES_PER_BUCKET", "4"))
+    n_split = int(
+        os.environ.get(
+            "BENCH_FLEET_SPLIT_BUCKETS", str(max(1, (n_buckets * 4) // 10))
+        )
+    )
+    chunk = int(os.environ.get("BENCH_FLEET_CHUNK", "2"))
+    config = _fleet_build_fleet(n_buckets, per_bucket, n_split, chunk)
+    total = len(config["machines"])
+
+    workdir = tempfile.mkdtemp(prefix="gordo-fleet-bench-")
+    worker_py = os.path.join(workdir, "fleet_worker.py")
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    with open(worker_py, "w") as f:
+        f.write(_FLEET_BUILD_WORKER.format(repo=repo_root))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # the workers pin their own XLA topology; a scheduler-dir or
+        # fault-plan override from the outer run must not leak in
+        if not k.startswith("XLA_FLAGS")
+        and k not in ("GORDO_TPU_SCHEDULER_DIR", "GORDO_TPU_FAULT_PLAN")
+    }
+    # small chunk-granular units: several same-shaped leases per bucket,
+    # so the compile-affinity placement and the program reuse that
+    # compile_seconds_saved counts are actually exercised
+    env["GORDO_TPU_CHUNK_MACHINES"] = str(chunk)
+
+    def run_arm(policy: str) -> "tuple[list, float]":
+        arm_dir = os.path.join(workdir, policy)
+        os.makedirs(arm_dir)
+        with open(os.path.join(arm_dir, "config.yaml"), "w") as f:
+            json.dump(config, f)  # yaml loads json
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker_py, str(rank), arm_dir, policy],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in (0, 1)
+        ]
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        payloads = []
+        for p, out in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"fleet_build {policy} worker failed: {out[-1500:]}"
+                )
+            lines = [l for l in out.splitlines() if l.startswith("FLEET ")]
+            payloads.append(json.loads(lines[-1][len("FLEET "):]))
+        return payloads, max(p["wall_sec"] for p in payloads)
+
+    static_payloads, static_wall = run_arm("static")
+    elastic_payloads, elastic_wall = run_arm("elastic")
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    built_elastic = sum(p["built"] for p in elastic_payloads)
+    steals = sum(p["stats"]["leases_steal"] for p in elastic_payloads)
+    return {
+        "machines": total,
+        "buckets": n_buckets,
+        "split_buckets": n_split,
+        "static_wall_sec": static_wall,
+        "elastic_wall_sec": elastic_wall,
+        "built": built_elastic,
+        "machines_per_sec": round(total / elastic_wall, 3),
+        "speedup_vs_static": round(static_wall / elastic_wall, 3),
+        "steals_total": steals,
+        "lease_expirations": sum(
+            p["stats"]["lease_expirations"] for p in elastic_payloads
+        ),
+        "compile_seconds_saved": round(
+            sum(p["compile_seconds_saved"] for p in elastic_payloads), 3
+        ),
+        "static_compile_seconds_saved": round(
+            sum(p["compile_seconds_saved"] for p in static_payloads), 3
+        ),
+        "static_workers": static_payloads,
+        "elastic_workers": elastic_payloads,
+    }
+
+
 def _section_child(name: str) -> None:
     """Child entrypoint: resolve a backend the same way main() does, run the
     section, print its ``{"platform", "result"}`` envelope as the last
@@ -1336,6 +1569,7 @@ def _section_child(name: str) -> None:
         "headline": _bench_headline,
         "windowed": _bench_windowed,
         "batch_ab": _bench_batch_ab,
+        "fleet_build": _bench_fleet_build,
     }
     result = sections[name]()
     envelope = {"platform": jax.devices()[0].platform, "result": result}
@@ -1429,6 +1663,8 @@ def main():
             enabled.remove("windowed")
         if os.environ.get("BENCH_BATCH_AB", "1") == "0":
             enabled.remove("batch_ab")
+        if os.environ.get("BENCH_FLEET_BUILD", "1") == "0":
+            enabled.remove("fleet_build")
 
     # every canonical section appears in the record, disabled ones
     # included — "no section unaccounted for" is the schema's core promise
@@ -1581,6 +1817,7 @@ def _emit_record(sections: dict, recovered: list):
     batch_ab = sections.get("batch_ab") or {}
     smoke = sections.get("tpu_smoke") or {}
     serving_load = sections.get("serving_load") or {}
+    fleet_build = sections.get("fleet_build") or {}
     head = headline.get("result") or {}
 
     serving = head.get("serving", {})
@@ -1599,7 +1836,7 @@ def _emit_record(sections: dict, recovered: list):
     # 'unknown' and break bench_compare's platform matching
     platform = headline.get("platform")
     if not platform:
-        for entry in (smoke, serving_load, windowed, batch_ab):
+        for entry in (smoke, serving_load, windowed, batch_ab, fleet_build):
             if entry.get("platform"):
                 platform = entry["platform"]
                 break
@@ -1615,6 +1852,7 @@ def _emit_record(sections: dict, recovered: list):
         "serving_load": serving_load,
         "windowed": windowed,
         "batch_ab": batch_ab,
+        "fleet_build": fleet_build,
         "platform": platform,
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
         "sections": {
@@ -1636,6 +1874,7 @@ def _emit_record(sections: dict, recovered: list):
 
     win = windowed.get("result") or {}
     ab = batch_ab.get("result") or {}
+    fb = fleet_build.get("result") or {}
     smoke_res = smoke.get("result") or {}
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
@@ -1729,6 +1968,19 @@ def _emit_record(sections: dict, recovered: list):
                 for k, v in ab.items()
                 if isinstance(v, dict)
             },
+        },
+        # the elastic scheduler's skewed 2-host A/B (ISSUE 10): flat keys
+        # so bench_compare.py gates them like any headline metric
+        "fleet_build_machines_per_sec": fb.get("machines_per_sec"),
+        "fleet_build_compile_seconds_saved": fb.get("compile_seconds_saved"),
+        "fleet_build_steals_total": fb.get("steals_total"),
+        "fleet_build": {
+            "platform": fleet_build.get("platform"),
+            "speedup_vs_static": fb.get("speedup_vs_static"),
+            "static_wall_sec": fb.get("static_wall_sec"),
+            "elastic_wall_sec": fb.get("elastic_wall_sec"),
+            "machines": fb.get("machines"),
+            "split_buckets": fb.get("split_buckets"),
         },
         "detail_file": detail_file,
         # schema v2: every canonical section accounted for with an

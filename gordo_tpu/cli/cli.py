@@ -292,6 +292,44 @@ def _report_exception_and_exit(
     "cache instead of retraining",
 )
 @click.option(
+    "--elastic",
+    is_flag=True,
+    default=False,
+    envvar="GORDO_TPU_ELASTIC",
+    help="Work-stealing fleet scheduler instead of the static multi-host "
+    "partition: each host runs single-process and leases buckets from a "
+    "shared queue under --output-dir, stealing a peer's units when it "
+    "drains its own share or the peer's lease expires (host death). Do "
+    "not combine with --coordinator-address; --process-id/--num-processes "
+    "become the host's nominal rank/count for steal accounting. See "
+    "docs/components/fleet_training.md",
+)
+@click.option(
+    "--lease-timeout-s",
+    type=float,
+    default=None,
+    envvar="GORDO_TPU_LEASE_TIMEOUT_S",
+    help="Elastic mode: seconds without a heartbeat before a peer's lease "
+    "counts as dead and its unit is stolen (default 60)",
+)
+@click.option(
+    "--heartbeat-s",
+    type=float,
+    default=None,
+    envvar="GORDO_TPU_HEARTBEAT_S",
+    help="Elastic mode: interval between lease-file heartbeat rewrites "
+    "(default lease-timeout/4)",
+)
+@click.option(
+    "--warm-start/--no-warm-start",
+    default=None,
+    envvar="GORDO_TPU_WARM_START",
+    help="Delta rebuilds: when a machine's full cache key misses but its "
+    "config/spec fingerprint matches a registered artifact (only the data "
+    "drifted), reuse that artifact's params as training init instead of a "
+    "random init. Default on when --model-register-dir is set",
+)
+@click.option(
     "--fail-fast",
     is_flag=True,
     default=False,
@@ -336,6 +374,10 @@ def batch_build(
     num_processes: int,
     process_id: int,
     model_register_dir: str,
+    elastic: bool,
+    lease_timeout_s: float,
+    heartbeat_s: float,
+    warm_start: bool,
     fail_fast: bool,
     quarantine_report_file: str,
     trace_file: str,
@@ -371,7 +413,20 @@ def batch_build(
         from gordo_tpu.parallel import BatchedModelBuilder, distributed
         from gordo_tpu.workflow.normalized_config import NormalizedConfig
 
-        distributed.initialize(coordinator_address, num_processes, process_id)
+        if elastic:
+            # elastic mode replaces the jax.distributed world: each host is
+            # an independent single-process runtime coordinating only via
+            # the shared output_dir queue
+            if coordinator_address:
+                logger.warning(
+                    "--elastic ignores --coordinator-address: hosts "
+                    "coordinate through the shared output_dir, not "
+                    "jax.distributed"
+                )
+        else:
+            distributed.initialize(
+                coordinator_address, num_processes, process_id
+            )
         native.prebuild(block=True)
         from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
 
@@ -397,6 +452,12 @@ def batch_build(
             output_dir=output_dir,
             model_register_dir=model_register_dir,
             fail_fast=fail_fast,
+            elastic=elastic,
+            warm_start=warm_start,
+            lease_timeout_s=lease_timeout_s,
+            heartbeat_s=heartbeat_s,
+            host_rank=process_id,
+            num_hosts=num_processes,
         )
         # the builder persists every machine as soon as its chunk finishes
         # (checkpoint/resume); reporting stays here, after the fleet

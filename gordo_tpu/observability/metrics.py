@@ -86,6 +86,33 @@ XLA_CACHE_ENTRIES_ADDED = telemetry.counter(
     "(cold compiles that future builds will skip)",
 )
 
+# --------------------------------------- elastic fleet scheduler (ISSUE 10)
+# wired by parallel/scheduler.py + parallel/batch_trainer.py; a "steal" is
+# any lease of a unit nominally owned by a peer (finish-early rebalance or
+# expired-lease takeover)
+SCHEDULER_LEASES = telemetry.counter(
+    "gordo_build_scheduler_leases_total",
+    "Work-unit leases acquired by this host from the shared fleet-build "
+    "queue, by kind (fresh: own nominal share; steal: a peer's unit, "
+    "either finish-early rebalance or expired-lease takeover)",
+    ("kind",),
+)
+SCHEDULER_LEASE_EXPIRATIONS = telemetry.counter(
+    "gordo_build_scheduler_lease_expirations_total",
+    "Stale leases this host took over past GORDO_TPU_LEASE_TIMEOUT_S "
+    "(the holder stopped heartbeating: host death or a wedged build)",
+)
+WARM_STARTS = telemetry.counter(
+    "gordo_build_warm_starts_total",
+    "Machines whose training initialized from the prior artifact's params "
+    "(warm-start delta rebuild: config/spec unchanged, only data drifted)",
+)
+FLEET_MACHINES_REMAINING = telemetry.gauge(
+    "gordo_build_fleet_machines_remaining",
+    "Machines in fleet-build work units not yet marked done on the shared "
+    "queue, sampled each time this host asks for a lease",
+)
+
 # ------------------------------------------------------------- serving path
 # sub-second buckets: queue waits are bounded by one fused device call
 BATCHER_QUEUE_WAIT_SECONDS = telemetry.histogram(

@@ -14,6 +14,7 @@ SPMD; collectives only appear in the multi-host data path).
 from . import distributed
 from .mesh import default_mesh, machines_sharding
 from .batch_trainer import BatchedModelBuilder
+from .scheduler import ElasticScheduler, WorkUnit, unit_id_for
 from .ring_attention import make_ring_attention, sequence_sharding
 from .tensor_parallel import prepare_tp_spec, shard_params_tp, tp_mesh
 from .pipeline_parallel import make_pipeline_blocks_fn, prepare_pp_spec, pp_mesh
@@ -24,6 +25,9 @@ __all__ = [
     "default_mesh",
     "machines_sharding",
     "BatchedModelBuilder",
+    "ElasticScheduler",
+    "WorkUnit",
+    "unit_id_for",
     "make_ring_attention",
     "sequence_sharding",
     "prepare_tp_spec",

@@ -141,6 +141,10 @@ class _Plan:
     # how many data-fetch attempts it took (>1 = transient faults absorbed;
     # recorded in BuildMetadata.fault_domain for observability)
     fetch_attempts: int = 1
+    # warm-start delta rebuild: the prior artifact's trained params, used as
+    # init in place of init_model_params when only the machine's data
+    # drifted (same spec/config — the warm registry key matched)
+    warm_params: Optional[Any] = None
 
     def bucket_key(self) -> Tuple:
         return (
@@ -152,6 +156,9 @@ class _Plan:
             self.scale_x,
             self.n_splits,
             self.cv,
+            # warm and cold machines cannot share a program (different
+            # argument structure), so they bucket separately
+            self.warm_params is not None,
         )
 
 
@@ -361,6 +368,7 @@ def _bucket_program(
     scale_x: bool,
     out_sharding=None,
     use_perms: bool = False,
+    warm_start: bool = False,
 ):
     """
     Compile the full per-machine build for one bucket:
@@ -388,6 +396,12 @@ def _bucket_program(
     ``out_sharding``: force every output's machine axis onto this sharding.
     Required in multi-process mode, where each host reads back only its
     addressable rows — XLA must not replicate outputs.
+
+    ``warm_start``: the program takes a trailing, vmapped pytree argument
+    ``warm`` — each machine's prior trained params, used as init in place
+    of ``init_model_params`` for every stage (each CV fold and the final
+    fit). A delta rebuild whose data merely drifted starts each fit from
+    yesterday's optimum instead of a random init.
     """
     te_lens = {te_end - te_start for _, te_start, te_end in fold_bounds}
     if len(te_lens) != 1:
@@ -396,7 +410,7 @@ def _bucket_program(
         # planner pads bounds to the max fold size)
         return _bucket_program_unrolled(
             spec, n_rows, fold_bounds, epochs, batch_size, shuffle, scale_x,
-            out_sharding,
+            out_sharding, warm_start=warm_start,
         )
     te_len = te_lens.pop()
 
@@ -413,7 +427,11 @@ def _bucket_program(
     )
     te_starts = np.array([te_start for _, te_start, _ in fold_bounds] + [0])
 
-    def one_machine(X, y, seed, perms=None):
+    def one_machine(X, y, seed, *extra):
+        # extra: (perms?, warm?) — perms is shared (not vmapped), warm is
+        # per-machine (vmapped); order fixed by the in_axes below
+        perms = extra[0] if use_perms else None
+        warm = extra[len(extra) - 1] if warm_start else None
         rng = jax.random.fold_in(jax.random.PRNGKey(0), seed)
 
         def stage(_, inp):
@@ -434,7 +452,7 @@ def _bucket_program(
                 Xs = (Xk - mn) * scale
             else:
                 Xs = Xk
-            params = init_model_params(k_init, spec)
+            params = warm if warm_start else init_model_params(k_init, spec)
             opt_state = opt.init(params)
 
             def epoch_body(carry, epoch_rng):
@@ -462,10 +480,12 @@ def _bucket_program(
         # tuple-of-folds output keeps the same contract as the unrolled path
         return p_final, losses_all[-1], tuple(preds_all[k] for k in range(n_folds))
 
+    in_axes: Tuple = (0, 0, 0)
     if use_perms:
-        batched = jax.vmap(one_machine, in_axes=(0, 0, 0, None))
-    else:
-        batched = jax.vmap(one_machine)
+        in_axes = in_axes + (None,)
+    if warm_start:
+        in_axes = in_axes + (0,)
+    batched = jax.vmap(one_machine, in_axes=in_axes)
     if out_sharding is not None:
         return jax.jit(batched, out_shardings=out_sharding)
     return jax.jit(batched)
@@ -480,6 +500,7 @@ def _bucket_program_unrolled(
     shuffle: bool,
     scale_x: bool,
     out_sharding=None,
+    warm_start: bool = False,
 ):
     """Fallback bucket program with one separately-shaped fit per fold
     (pre-fused structure); only used when fold test slices are unequal."""
@@ -492,7 +513,8 @@ def _bucket_program_unrolled(
         for tr_end, _, _ in fold_bounds
     ]
 
-    def one_machine(X, y, seed):
+    def one_machine(X, y, seed, *extra):
+        warm = extra[0] if warm_start else None
         rng = jax.random.fold_in(jax.random.PRNGKey(0), seed)
         fold_preds = []
         for k, (tr_end, te_start, te_end) in enumerate(fold_bounds):
@@ -502,17 +524,19 @@ def _bucket_program_unrolled(
             if scale_x:
                 Xte = _minmax(Xtr, Xte)
                 Xtr = _minmax(Xtr, Xtr)
-            p0 = init_model_params(k_init, spec)
+            p0 = warm if warm_start else init_model_params(k_init, spec)
             p, _ = fold_fits[k](p0, Xtr, ytr, k_fit)
             fold_preds.append(_predict_windows(spec, p, Xte))
 
         k_init, k_fit = jax.random.split(jax.random.fold_in(rng, len(fold_bounds)))
         Xs = _minmax(X, X) if scale_x else X
-        p0 = init_model_params(k_init, spec)
+        p0 = warm if warm_start else init_model_params(k_init, spec)
         p_final, losses = fit_full(p0, Xs, y, k_fit)
         return p_final, losses, tuple(fold_preds)
 
-    batched = jax.vmap(one_machine)
+    batched = jax.vmap(
+        one_machine, in_axes=(0, 0, 0, 0) if warm_start else (0, 0, 0)
+    )
     if out_sharding is not None:
         return jax.jit(batched, out_shardings=out_sharding)
     return jax.jit(batched)
@@ -569,6 +593,14 @@ class BatchedModelBuilder:
         replace_cache: bool = False,
         fail_fast: bool = False,
         fault_policy: Optional[FaultPolicy] = None,
+        elastic: Optional[bool] = None,
+        warm_start: Optional[bool] = None,
+        scheduler_dir: Optional[str] = None,
+        scheduler_policy: str = "elastic",
+        lease_timeout_s: Optional[float] = None,
+        heartbeat_s: Optional[float] = None,
+        host_rank: Optional[int] = None,
+        num_hosts: Optional[int] = None,
     ):
         """
         ``chunk_size``: machines per compiled program. Large buckets are cut
@@ -596,6 +628,26 @@ class BatchedModelBuilder:
 
         ``fault_policy``: retry/backoff/classification policy; defaults to
         ``FaultPolicy.from_env()`` (``GORDO_TPU_FAULT_*`` variables).
+
+        ``elastic``: replace the static multi-host partition with the
+        work-stealing scheduler (parallel/scheduler.py): hosts lease
+        buckets from a shared queue under ``output_dir`` and steal a peer's
+        remaining units when they drain their own share or the peer's
+        lease expires. Each host runs a *single-process* jax world (do not
+        combine with ``distributed.initialize``); coordination is purely
+        the shared filesystem. Default from ``$GORDO_TPU_ELASTIC``.
+        ``scheduler_policy="static"`` keeps the queue's nominal partition
+        with no stealing (the measured baseline for the fleet_build
+        bench). ``host_rank``/``num_hosts`` default to
+        ``$GORDO_TPU_PROCESS_ID``/``$GORDO_TPU_NUM_PROCESSES``.
+
+        ``warm_start``: when a machine's full cache key misses but its
+        *warm* key (config/spec, data excluded —
+        ``ModelBuilder.calculate_warm_key``) matches a registered
+        artifact, reuse that artifact's trained params as training init
+        instead of a random init (delta rebuild of a drifted fleet).
+        Default on with a ``model_register_dir``; ``$GORDO_TPU_WARM_START=0``
+        disables.
         """
         self.machines = machines
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -608,6 +660,22 @@ class BatchedModelBuilder:
         self.replace_cache = replace_cache
         self.fail_fast = fail_fast
         self.fault_policy = fault_policy or FaultPolicy.from_env()
+        if elastic is None:
+            elastic = os.environ.get("GORDO_TPU_ELASTIC", "") not in ("", "0")
+        self.elastic = bool(elastic)
+        if warm_start is None:
+            raw = os.environ.get("GORDO_TPU_WARM_START", "")
+            warm_start = raw not in ("0",)
+        self.warm_start = bool(warm_start)
+        self.scheduler_dir = scheduler_dir
+        self.scheduler_policy = scheduler_policy
+        self.lease_timeout_s = lease_timeout_s
+        self.heartbeat_s = heartbeat_s
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        # the live ElasticScheduler of the current/most recent elastic
+        # build(): tests and the fleet_build bench read its stats
+        self.scheduler = None
         # fault-domain outcome of the last build(): Machine objects whose
         # BuildMetadata.fault_domain records stage/reason, plus the raw
         # records (the CLI exit report reads both)
@@ -780,8 +848,87 @@ class BatchedModelBuilder:
                 ModelBuilder.calculate_cache_key(machine),
                 model_dir,
             )
+            # warm-start registry: a future build whose full key misses
+            # (data drifted) finds this artifact by config/spec alone and
+            # reuses its params as training init
+            disk_registry.write_key(
+                self.model_register_dir,
+                ModelBuilder.calculate_warm_key(machine),
+                model_dir,
+            )
+
+    def _maybe_warm_params(self, machine: Machine, spec: ModelSpec):
+        """The prior artifact's trained params for a warm-start delta
+        rebuild, or None: warm registry miss, unloadable artifact, or a
+        param tree whose structure/shapes no longer match the spec (the
+        "only data drifted" premise failed — cold init is the safe answer).
+        """
+        if not self.warm_start or not self.model_register_dir:
+            return None
+        from gordo_tpu.util import disk_registry
+
+        path = disk_registry.get_value(
+            self.model_register_dir, ModelBuilder.calculate_warm_key(machine)
+        )
+        if not path or not os.path.isdir(path):
+            return None
+        try:
+            model = serializer.load(path)
+        except Exception:  # noqa: BLE001 — a corrupt prior artifact only
+            return None  # costs the warm start, never the build
+        inner = model
+        if isinstance(inner, DiffBasedAnomalyDetector):
+            inner = inner.base_estimator
+        if isinstance(inner, Pipeline):
+            inner = inner.steps[-1][1]
+        params = getattr(inner, "params_", None)
+        if params is None:
+            return None
+        try:
+            ref = jax.eval_shape(
+                lambda: init_model_params(jax.random.PRNGKey(0), spec)
+            )
+            ref_leaves, ref_def = jax.tree_util.tree_flatten(ref)
+            leaves, tree_def = jax.tree_util.tree_flatten(params)
+            if tree_def != ref_def or len(leaves) != len(ref_leaves):
+                return None
+            out = []
+            for leaf, r in zip(leaves, ref_leaves):
+                arr = np.asarray(leaf)
+                if arr.shape != tuple(r.shape):
+                    return None
+                out.append(arr.astype(r.dtype, copy=False))
+            return jax.tree_util.tree_unflatten(ref_def, out)
+        except Exception:  # noqa: BLE001 — same rationale as above
+            return None
+
+    def _attach_warm_params(self, plans: Dict[int, "_Plan"]) -> None:
+        """Fill plan.warm_params for full-cache-missed machines (threaded:
+        one serializer.load per warm hit)."""
+        if not self.warm_start or not self.model_register_dir or not plans:
+            return
+        items = list(plans.values())
+        with ThreadPoolExecutor(max_workers=min(16, len(items))) as pool:
+            warms = list(
+                pool.map(
+                    lambda p: self._maybe_warm_params(p.machine, p.spec), items
+                )
+            )
+        n_warm = 0
+        for plan, warm in zip(items, warms):
+            if warm is not None:
+                plan.warm_params = warm
+                n_warm += 1
+        if n_warm:
+            metric_catalog.WARM_STARTS.inc(n_warm)
+            logger.info(
+                "warm-start delta rebuild: %d of %d machines initialize "
+                "from their prior artifact's params", n_warm, len(items),
+            )
 
     def _build_all(self, distributed) -> List[Tuple[Any, Machine]]:
+        if self.elastic:
+            return self._build_all_elastic(distributed)
         results: Dict[int, Tuple[Any, Machine]] = {}
         plans: Dict[int, _Plan] = {}
         serial: List[int] = []
@@ -915,6 +1062,8 @@ class BatchedModelBuilder:
                 )
                 del plans[i]
 
+        self._attach_warm_params(plans)
+
         buckets: Dict[Tuple, List[int]] = {}
         for i, plan in plans.items():
             buckets.setdefault(plan.bucket_key(), []).append(i)
@@ -925,6 +1074,271 @@ class BatchedModelBuilder:
                 results[i] = built
 
         return [results[i] for i in sorted(results)]
+
+    def _build_all_elastic(self, distributed) -> List[Tuple[Any, Machine]]:
+        """The work-stealing fleet build (parallel/scheduler.py): every
+        host plans the same fleet deterministically, derives the same work
+        units, then leases them one at a time from the shared queue until
+        no unit is pending. Fast hosts drain their nominal share and steal
+        a peer's; a dead host's lease goes stale and its in-flight unit is
+        re-leased, re-entering the normal fault ladder
+        (``_build_bucket_guarded``) on the stealing host.
+
+        Per-host data fetches cover every *planned* machine (each host may
+        end up building any bucket), a deliberate v1 tradeoff documented in
+        docs/components/fleet_training.md — the provider I/O is threaded
+        and the artifacts, not the fetches, dominate a fleet build.
+        """
+        from gordo_tpu.parallel.scheduler import (
+            ElasticScheduler,
+            WorkUnit,
+            scheduler_dir_for,
+            unit_id_for,
+        )
+
+        if distributed.is_multiprocess():
+            raise RuntimeError(
+                "elastic scheduling replaces the jax.distributed world: "
+                "run one single-process build per host against the shared "
+                "output_dir (no --coordinator-address)"
+            )
+        base_dir = self.scheduler_dir or (
+            scheduler_dir_for(self.output_dir) if self.output_dir else None
+        )
+        if base_dir is None:
+            raise ValueError(
+                "elastic builds need shared state: set output_dir (the "
+                "queue lives in its _scheduler/ subdir) or scheduler_dir"
+            )
+
+        results: Dict[int, Tuple[Any, Machine]] = {}
+        plans: Dict[int, _Plan] = {}
+        serial: List[int] = []
+        sched = ElasticScheduler(
+            base_dir,
+            host_rank=self.host_rank,
+            num_hosts=self.num_hosts,
+            lease_timeout_s=self.lease_timeout_s,
+            heartbeat_s=self.heartbeat_s,
+            policy=self.scheduler_policy,
+        )
+        self.scheduler = sched
+        try:
+            n_done = sum(
+                1 for n in os.listdir(sched.done_dir) if n.endswith(".json")
+            )
+        except OSError:
+            n_done = 0
+        if n_done:
+            # scheduler state is per-BUILD-ATTEMPT: markers from a crashed
+            # run of this same build correctly skip completed units, but a
+            # logically new build must not inherit them
+            logger.warning(
+                "elastic scheduler state at %s already holds %d done "
+                "markers: resuming that build (units they cover are "
+                "skipped; a new build needs a fresh output_dir or "
+                "scheduler_dir)",
+                base_dir, n_done,
+            )
+        try:
+            # resume prefilter, elastic form: full-key registry hits are
+            # claimed exactly once fleet-wide by a done marker instead of
+            # the hash partition — whoever claims first loads and returns
+            # the machine; everyone else drops it entirely
+            cached_paths: Dict[int, str] = {}
+            if self.model_register_dir and self.machines:
+                idxs = list(range(len(self.machines)))
+                with ThreadPoolExecutor(max_workers=min(16, len(idxs))) as pool:
+                    paths = list(
+                        pool.map(
+                            lambda i: self._cached_path(self.machines[i]), idxs
+                        )
+                    )
+                cached_paths = {i: p for i, p in zip(idxs, paths) if p}
+
+            for i, machine in enumerate(self.machines):
+                if i in cached_paths:
+                    if not sched.try_claim(
+                        unit_id_for([machine.name], "cached"),
+                        {"machine": machine.name},
+                    ):
+                        continue  # a peer claimed and returns this hit
+                    cached = self._load_cached_guarded(i, cached_paths[i])
+                    if cached is not None:
+                        logger.info(
+                            "Machine %s: loaded from cache", machine.name
+                        )
+                        metric_catalog.BUILD_MACHINES.labels(
+                            outcome="cached"
+                        ).inc()
+                        results[i] = cached
+                        model_dir = self._machine_output_dir(machine.name)
+                        if model_dir and not os.path.exists(
+                            os.path.join(model_dir, "model.pkl")
+                        ):
+                            self._persist(machine, *cached)
+                        continue
+                    # corrupt artifact: we hold the claim; rebuild below
+                plan = _plan_machine(machine)
+                if plan is None:
+                    serial.append(i)
+                else:
+                    plans[i] = plan
+
+            for i in serial:
+                if not self.serial_fallback:
+                    raise ValueError(
+                        f"Machine {self.machines[i].name} is not batchable "
+                        f"and serial_fallback=False"
+                    )
+
+            # data fetch + validation: same guarded paths as the static
+            # build, except quarantines are claim-gated — every host
+            # observes the same bad feed, exactly one records it
+            if plans:
+                max_workers = min(16, len(plans))
+                with ThreadPoolExecutor(max_workers=max_workers) as pool:
+                    records = list(
+                        pool.map(self._load_data_guarded, plans.values())
+                    )
+                for (i, plan), record in zip(list(plans.items()), records):
+                    if record is not None:
+                        self._quarantine_claimed(sched, plan.machine, record)
+                        del plans[i]
+
+            for i in list(plans):
+                plan = plans[i]
+                with _machine_trace(plan.machine.name), telemetry.span(
+                    "validate", _PHASE_VALIDATE, machine=plan.machine.name
+                ):
+                    bad = faults.non_finite_report(plan.X, plan.y)
+                if bad is not None:
+                    if self.fail_fast:
+                        raise faults.NonFiniteDataError(
+                            f"machine {plan.machine.name}: {bad}"
+                        )
+                    self._quarantine_claimed(
+                        sched,
+                        plan.machine,
+                        QuarantineRecord(
+                            machine=plan.machine.name,
+                            stage=faults.STAGE_DATA_VALIDATION,
+                            reason="non_finite_data",
+                            error=bad,
+                        ),
+                    )
+                    del plans[i]
+
+            self._attach_warm_params(plans)
+
+            buckets: Dict[Tuple, List[int]] = {}
+            for i, plan in plans.items():
+                buckets.setdefault(plan.bucket_key(), []).append(i)
+
+            units: Dict[str, WorkUnit] = {}
+            members: Dict[str, Tuple[str, List[int]]] = {}
+            for key, idxs in buckets.items():
+                # lease granularity is the dispatch chunk, not the whole
+                # bucket: a big bucket becomes several units SHARING one
+                # compile signature, so (a) it balances across hosts at
+                # all and (b) the placement affinity + in-process program
+                # cache actually get same-shaped leases to reuse
+                for start in range(0, len(idxs), self.chunk_size):
+                    group = idxs[start : start + self.chunk_size]
+                    names = tuple(
+                        sorted(self.machines[i].name for i in group)
+                    )
+                    uid = unit_id_for(names, "bucket")
+                    units[uid] = WorkUnit(
+                        unit_id=uid,
+                        machines=names,
+                        # compile-affinity signature: the program cache
+                        # key's shape-determining parts (everything but
+                        # membership)
+                        signature=repr(key),
+                        kind="bucket",
+                        cost=len(group),
+                    )
+                    members[uid] = ("bucket", group)
+            for i in serial:
+                name = self.machines[i].name
+                uid = unit_id_for([name], "serial")
+                units[uid] = WorkUnit(
+                    unit_id=uid, machines=(name,), kind="serial", cost=1
+                )
+                members[uid] = ("serial", [i])
+
+            while True:
+                lease = sched.next_lease(units)
+                if lease is None:
+                    break
+                faults.fault_point(
+                    "scheduler_lease", machines=lease.unit.machines
+                )
+                kind, idxs = members[lease.unit.unit_id]
+                if kind == "serial":
+                    built_list = self._build_serial_elastic(sched, idxs[0])
+                else:
+                    bucket_plans = [plans[i] for i in idxs]
+                    built_list = self._build_bucket_guarded(bucket_plans, idxs)
+                if not sched.still_current(lease):
+                    # a peer stole this lease mid-build (we looked dead);
+                    # its result is authoritative, ours is the byte-same
+                    # duplicate — discard without recording
+                    logger.warning(
+                        "lost lease on %s to a peer mid-build; discarding "
+                        "this host's duplicate results", lease.unit.unit_id,
+                    )
+                    continue
+                for i, built in built_list:
+                    results[i] = built
+                sched.note_compiled(lease.unit.signature)
+                sched.mark_done(lease, {"built": len(built_list)})
+        finally:
+            sched.close()
+
+        return [results[i] for i in sorted(results)]
+
+    def _quarantine_claimed(self, sched, machine: Machine, record) -> None:
+        """Quarantine under the elastic exactly-once contract: the claim
+        winner records the machine (report + metrics); losers only mark it
+        locally dead so no bucket re-admits it."""
+        from gordo_tpu.parallel.scheduler import unit_id_for
+
+        if sched.try_claim(
+            unit_id_for([record.machine], "quarantine"), record.to_dict()
+        ):
+            self._quarantine(machine, record=record)
+        else:
+            self._quarantined_names.add(record.machine)
+
+    def _build_serial_elastic(
+        self, sched, i: int
+    ) -> List[Tuple[int, Tuple[Any, Machine]]]:
+        """One leased serial-fallback machine (elastic path)."""
+        machine = self.machines[i]
+        logger.info("Machine %s: serial fallback", machine.name)
+        metric_catalog.SERIAL_FALLBACKS.labels(reason="unbatchable").inc()
+        try:
+            built = ModelBuilder(machine).build(
+                output_dir=self._machine_output_dir(machine.name),
+                model_register_dir=self.model_register_dir,
+            )
+            return [(i, built)]
+        except Exception as exc:
+            if self.fail_fast:
+                raise
+            self._quarantine_claimed(
+                sched,
+                machine,
+                QuarantineRecord(
+                    machine=machine.name,
+                    stage=faults.STAGE_SERIAL_BUILD,
+                    reason=type(exc).__name__,
+                    error=str(exc),
+                ),
+            )
+            return []
 
     def _fold_bounds(self, n_rows: int, n_splits: int) -> Tuple[Tuple[int, int, int], ...]:
         splitter = TimeSeriesSplit(n_splits=n_splits)
@@ -1087,6 +1501,7 @@ class BatchedModelBuilder:
         from gordo_tpu.parallel import distributed
 
         multiprocess = distributed.is_multiprocess()
+        warm = plan0.warm_params is not None
         sharding = machines_sharding(self.mesh)
         program_key = (
             spec,
@@ -1098,6 +1513,7 @@ class BatchedModelBuilder:
             plan0.scale_x,
             sharding if multiprocess else None,
             perms is not None,
+            warm,
         )
         cache_before = _bucket_program.cache_info()
         program = _bucket_program(
@@ -1110,6 +1526,7 @@ class BatchedModelBuilder:
             plan0.scale_x,
             out_sharding=sharding if multiprocess else None,
             use_perms=perms is not None,
+            warm_start=warm,
         )
         # program-cache effectiveness: a hit reuses an already-compiled
         # program; credit its remembered first-compile wall as time saved
@@ -1151,9 +1568,25 @@ class BatchedModelBuilder:
             X_d = distributed.make_global_stacked(sharding, X)
             y_d = distributed.make_global_stacked(sharding, y)
             seeds_d = distributed.make_global_stacked(sharding, seeds)
+            args = (X_d, y_d, seeds_d)
             if perms_d is not None:
-                return group, program(X_d, y_d, seeds_d, perms_d)
-            return group, program(X_d, y_d, seeds_d)
+                args = args + (perms_d,)
+            if warm:
+                # stack each machine's prior params on the machine axis
+                # (padding lanes replicate group[0], like X/y above) and
+                # shard the stacked tree exactly like the other inputs
+                trees = [p.warm_params for p in group] + [
+                    group[0].warm_params
+                ] * pad
+                stacked = jax.tree_util.tree_map(
+                    lambda *leaves: np.stack(leaves), *trees
+                )
+                warm_d = jax.tree_util.tree_map(
+                    lambda a: distributed.make_global_stacked(sharding, a),
+                    stacked,
+                )
+                args = args + (warm_d,)
+            return group, program(*args)
 
         def fetch(group, outputs):
             params_stack, losses, fold_preds = outputs

@@ -84,10 +84,21 @@ def initialize(
         local_device_ids=local_device_ids,
     )
     _initialized = True
+    # one INFO line with the fully-RESOLVED topology through the structured
+    # log path (GORDO_TPU_LOG_FORMAT=json emits it as a parseable object):
+    # any single host's log shows the (rank, num_processes, coordinator)
+    # tuple it actually joined with, so a misconfigured world — two hosts
+    # claiming one rank, a stale coordinator address — is diagnosable from
+    # whichever host's log is at hand
+    from gordo_tpu.observability import logs
+
+    logs.maybe_configure()
     logger.info(
-        "distributed: process %d/%d up, %d local of %d global devices",
+        "distributed: up rank=%d num_processes=%d coordinator=%s "
+        "local_devices=%d global_devices=%d",
         jax.process_index(),
         jax.process_count(),
+        coordinator_address or "auto",
         len(jax.local_devices()),
         len(jax.devices()),
     )

@@ -49,7 +49,10 @@ starts firing (0 = fire from the first match) — "wedge the *Nth* device
 call" is ``{"after": N-1, "times": 1}``.
 ``error``: ``transient`` | ``permanent`` | ``resource_exhausted`` |
 ``wedge`` (sleep ``seconds`` at the fault point instead of raising — a
-stuck device call / hung dependency stand-in).
+stuck device call / hung dependency stand-in) | ``die`` (hard-exit the
+process via ``os._exit`` at the fault point — host death for the elastic
+scheduler's chaos suite; the victim's lease goes stale and a surviving
+host steals the unit).
 A ``bucket_compile`` rule matches any bucket whose member list contains
 ``machine``. Rules are matched in order and count their own firings, so a
 plan is a deterministic script, not a probability.
@@ -63,6 +66,12 @@ group's members; supports ``wedge``), and ``serve_poison_nan`` NaN-poisons
 the request's feature matrix before predict (pair with
 ``GORDO_TPU_VALIDATE_OUTPUT=1`` to turn the poisoned lane into a typed
 failure).
+
+Elastic-scheduler site (ISSUE 10, parallel/batch_trainer.py):
+``scheduler_lease`` fires right after a host acquires a lease on a work
+unit (machine matched against the unit's members) — pair it with
+``error="die"`` to kill a host at a deterministic point mid-build and
+exercise the lease-expiry steal path.
 """
 
 import json
@@ -266,15 +275,51 @@ def retry_call(
 
 
 # ---------------------------------------------------------------- quarantine
+def _observer_host() -> str:
+    """Identity of the host recording a quarantine: honors the elastic
+    scheduler's GORDO_TPU_HOST_ID so a pod-scale report attributes each
+    entry to the process that observed the fault."""
+    import socket
+
+    return (
+        os.environ.get("GORDO_TPU_HOST_ID")
+        or f"{socket.gethostname()}-{os.getpid()}"
+    )
+
+
+def _observer_process_index() -> int:
+    """This host's rank: the multi-host flag if set, else the live jax
+    process index when jax is already imported and initialized, else 0."""
+    raw = os.environ.get("GORDO_TPU_PROCESS_ID")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 — attribution must never fail a build
+            pass
+    return 0
+
+
 @dataclass
 class QuarantineRecord:
-    """Why one machine was dropped from a fleet build."""
+    """Why one machine was dropped from a fleet build — and by whom: the
+    ``host``/``process_index`` attribution makes a merged pod-scale
+    quarantine report traceable to the host that observed each fault."""
 
     machine: str
     stage: str
     reason: str
     error: str = ""
     attempts: int = 1
+    host: str = field(default_factory=_observer_host)
+    process_index: int = field(default_factory=_observer_process_index)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -284,6 +329,8 @@ class QuarantineRecord:
             "reason": self.reason,
             "error": self.error,
             "attempts": self.attempts,
+            "host": self.host,
+            "process_index": self.process_index,
         }
 
 
@@ -390,6 +437,14 @@ class FaultPlan:
                 )
                 time.sleep(rule.seconds)
                 return
+            if rule.error == "die":
+                # host death: no exception to catch, no atexit, no flushed
+                # buffers — the process is simply gone, exactly what the
+                # lease-expiry steal path must survive
+                logger.warning(
+                    "fault plan: host death at %s (machine %s)", site, machine
+                )
+                os._exit(17)
             raise rule.make_error(site, machine)
 
     def should_fire(self, site: str, machine: str) -> bool:

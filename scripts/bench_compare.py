@@ -64,6 +64,14 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # lower is better (burn 1.0 = consuming budget exactly as allowed)
     ("server_fleet_p99_ms", False),
     ("server_fleet_latency_burn_rate", False),
+    # elastic fleet-build scheduler A/B (ISSUE 10): throughput and the
+    # compile seconds saved by reuse-aware placement gate as
+    # higher-is-better; steals_total is informational-but-gated the same
+    # way (fewer steals on the same skew means stealing broke, which
+    # shows up as a machines_per_sec regression anyway)
+    ("fleet_build_machines_per_sec", True),
+    ("fleet_build_compile_seconds_saved", True),
+    ("fleet_build_steals_total", True),
 )
 
 # which harness section feeds each metric (schema v2 records carry a
@@ -81,6 +89,8 @@ def metric_section(key: str, parsed: dict) -> Optional[str]:
         return parsed.get("serving_source")
     if key.startswith(("server_load_", "server_fleet_")):
         return "serving_load"
+    if key.startswith("fleet_build_"):
+        return "fleet_build"
     return None
 
 

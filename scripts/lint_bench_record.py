@@ -12,9 +12,11 @@ enforcement pattern as the bare-except / metric-name / env-knob lints.
 Checked per record (a driver-written JSON with a ``parsed`` block):
 
 - the record parses and carries a ``parsed`` summary dict;
-- schema-v2 summaries (``schema_version`` >= 2) must have a
-  ``sections`` map covering every canonical section name with a status
-  from the known vocabulary, and numeric-or-null summary metrics;
+- schema-versioned summaries (``schema_version`` >= 2) must have a
+  ``sections`` map covering every canonical section name **of their own
+  schema version** (bench.py's ``SECTION_NAMES_BY_VERSION``; a v2 record
+  is not required to carry sections added in v3) with a status from the
+  known vocabulary, and numeric-or-null summary metrics;
 - records written before the schema (r01–r05) have no ``schema_version``
   and are reported as ``legacy`` — skipped unless ``--strict``, which
   turns them (and any ``parsed: null`` data-loss record) into failures.
@@ -49,29 +51,48 @@ _NUMERIC_KEYS = (
     "server_fleet_workers", "server_fleet_requests_total",
     "server_fleet_p99_ms", "server_fleet_error_burn_rate",
     "server_fleet_latency_burn_rate",
+    # the elastic fleet-build scheduler's A/B section (ISSUE 10)
+    "fleet_build_machines_per_sec", "fleet_build_compile_seconds_saved",
+    "fleet_build_steals_total",
 )
 
 
-def _section_contract() -> Tuple[List[str], List[str]]:
+# frozen per-version section lists for when bench.py is absent (running
+# the script from an sdist without the harness)
+_FALLBACK_NAMES_BY_VERSION = {
+    2: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"],
+    3: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build"],
+}
+_FALLBACK_STATUSES = [
+    "completed", "skipped_for_budget", "failed", "timeout", "disabled",
+]
+
+
+def _section_contract(schema_version: int) -> Tuple[List[str], List[str]]:
     """Canonical section names/statuses from bench.py itself (single
-    source of truth), with a frozen fallback when bench.py is absent
-    (running the script from an sdist without the harness)."""
+    source of truth), keyed by the RECORD's schema version — a v2 record
+    written before the fleet_build section exists must stay valid after
+    v3 adds it. Unknown (future) versions validate against the newest
+    list known here."""
     try:
         sys.path.insert(0, REPO_ROOT)
         import bench
 
-        return list(bench.SECTION_NAMES), list(bench.SECTION_STATUSES)
-    except Exception:  # noqa: BLE001 — the lint must run without the harness
-        return (
-            ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"],
-            ["completed", "skipped_for_budget", "failed", "timeout",
-             "disabled"],
+        by_version = bench.SECTION_NAMES_BY_VERSION
+        names = by_version.get(
+            schema_version, by_version[max(by_version)]
         )
+        return list(names), list(bench.SECTION_STATUSES)
+    except Exception:  # noqa: BLE001 — the lint must run without the harness
+        names = _FALLBACK_NAMES_BY_VERSION.get(
+            schema_version, _FALLBACK_NAMES_BY_VERSION[max(_FALLBACK_NAMES_BY_VERSION)]
+        )
+        return list(names), list(_FALLBACK_STATUSES)
 
 
 def validate_record(path: str, strict: bool = False) -> List[str]:
     """Violations for one record file ([] = valid or legacy-skipped)."""
-    names, statuses = _section_contract()
     try:
         with open(path) as fh:
             record = json.load(fh)
@@ -89,6 +110,10 @@ def validate_record(path: str, strict: bool = False) -> List[str]:
             ]
         print(f"{path}: legacy (pre-schema) record — skipped")
         return []
+    schema_version = parsed["schema_version"]
+    if not isinstance(schema_version, int):
+        return [f"{path}: parsed.schema_version is not an integer"]
+    names, statuses = _section_contract(schema_version)
 
     violations: List[str] = []
     sections = parsed.get("sections")

@@ -236,3 +236,25 @@ def test_quarantine_record_to_dict():
     d = record.to_dict()
     assert d["quarantined"] is True
     assert d["machine"] == "m" and d["attempts"] == 3
+
+
+def test_quarantine_record_attributes_observing_host(monkeypatch):
+    """A merged pod-scale quarantine report must say WHICH host observed
+    each fault: host/process_index ride along in every record."""
+    monkeypatch.setenv("GORDO_TPU_HOST_ID", "host-east-3")
+    monkeypatch.setenv("GORDO_TPU_PROCESS_ID", "3")
+    d = QuarantineRecord(
+        machine="m", stage="data_fetch", reason="r", error="e"
+    ).to_dict()
+    assert d["host"] == "host-east-3"
+    assert d["process_index"] == 3
+
+
+def test_quarantine_record_attribution_defaults(monkeypatch):
+    """Without the env knobs the attribution still resolves: hostname-pid
+    and the live jax process index (0 in a single-process world)."""
+    monkeypatch.delenv("GORDO_TPU_HOST_ID", raising=False)
+    monkeypatch.delenv("GORDO_TPU_PROCESS_ID", raising=False)
+    d = QuarantineRecord(machine="m", stage="s", reason="r", error="e").to_dict()
+    assert d["host"] and "-" in d["host"]
+    assert isinstance(d["process_index"], int) and d["process_index"] == 0

@@ -1,0 +1,517 @@
+"""
+Elastic fleet-build scheduler (parallel/scheduler.py) + the elastic build
+path of BatchedModelBuilder.
+
+Three layers of coverage:
+
+1. pure lease-protocol unit tests (no jax work): exactly-once acquisition,
+   steal-after-expiry with generation fencing, static-policy share
+   restriction, compile-affinity placement, exactly-once claims;
+2. in-process single-host elastic builds: full build, cache rerun with
+   zero retrains, warm-start delta rebuild retraining exactly the one
+   drifted machine;
+3. the 2-process chaos test: a host killed mid-build via the
+   ``scheduler_lease``/``die`` fault rule, the survivor steals its stale
+   lease, and the finished artifact set is byte-identical to a plain
+   single-host build of the same fleet.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+import pytest
+import yaml
+
+from gordo_tpu.parallel.scheduler import (
+    ElasticScheduler,
+    WorkUnit,
+    scheduler_dir_for,
+    unit_id_for,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sched(tmp_path, rank, num_hosts=2, **kw):
+    kw.setdefault("lease_timeout_s", 30.0)
+    kw.setdefault("heartbeat_s", 5.0)
+    return ElasticScheduler(
+        str(tmp_path),
+        host_id=f"host-{rank}",
+        host_rank=rank,
+        num_hosts=num_hosts,
+        **kw,
+    )
+
+
+def _unit(name, **kw):
+    return WorkUnit(unit_id_for([name]), (name,), **kw)
+
+
+# ------------------------------------------------------------ lease protocol
+def test_unit_id_stable_and_member_order_independent():
+    assert unit_id_for(["b", "a"]) == unit_id_for(["a", "b"])
+    assert unit_id_for(["a"]) != unit_id_for(["b"])
+    assert unit_id_for(["a"], "serial") != unit_id_for(["a"], "bucket")
+    assert unit_id_for(["a"], "serial").startswith("serial-")
+
+
+def test_two_hosts_drain_queue_without_overlap(tmp_path):
+    units = {}
+    for i in range(6):
+        u = _unit(f"part-m{i}", cost=i + 1)
+        units[u.unit_id] = u
+    h0, h1 = _sched(tmp_path, 0), _sched(tmp_path, 1)
+    taken = {0: [], 1: []}
+    pending = {0: True, 1: True}
+    while any(pending.values()):
+        for rank, h in ((0, h0), (1, h1)):
+            if not pending[rank]:
+                continue
+            lease = h.next_lease(units, poll_s=0.01)
+            if lease is None:
+                pending[rank] = False
+                continue
+            taken[rank].append(lease.unit.unit_id)
+            h.mark_done(lease, {"built": lease.unit.cost})
+    h0.close(), h1.close()
+
+    # every unit done exactly once, each by exactly one host
+    assert sorted(taken[0] + taken[1]) == sorted(units)
+    assert not (set(taken[0]) & set(taken[1]))
+    ledger = h0.summary()
+    assert sorted(e["unit"] for e in ledger) == sorted(units)
+    for entry in ledger:
+        assert entry["host"] in ("host-0", "host-1")
+        assert entry["kind"] == "bucket"
+    # steal accounting is by nominal share: every lease is either fresh
+    # (own share) or a steal (peer's share drained early) and they add up
+    for rank, h in ((0, h0), (1, h1)):
+        assert h.stats["leases_fresh"] + h.stats["leases_steal"] == len(
+            taken[rank]
+        )
+    # nobody expired — these were drain-steals, not dead-host takeovers
+    assert h0.stats["lease_expirations"] == 0
+    assert h1.stats["lease_expirations"] == 0
+
+
+def test_try_claim_is_exactly_once(tmp_path):
+    h0, h1 = _sched(tmp_path, 0), _sched(tmp_path, 1)
+    uid = unit_id_for(["cache-m0"], "cached")
+    assert h0.try_claim(uid, {"machine": "cache-m0"}) is True
+    assert h1.try_claim(uid, {"machine": "cache-m0"}) is False
+    assert h0.is_done(uid) and h1.is_done(uid)
+    assert h0.stats["claims"] == 1 and h1.stats["claims"] == 0
+    (entry,) = h0.summary()
+    assert entry["machine"] == "cache-m0" and entry["host"] == "host-0"
+    h0.close(), h1.close()
+
+
+def test_expired_lease_is_stolen_and_old_holder_fenced(tmp_path):
+    u = _unit("steal-m0")
+    units = {u.unit_id: u}
+    h0 = _sched(tmp_path, 0, lease_timeout_s=0.3, heartbeat_s=30.0)
+    l0 = h0.next_lease(units, poll_s=0.01)
+    assert l0 is not None and l0.generation == 1 and not l0.stolen
+    h0.close()  # heartbeat stops; the lease goes stale
+    time.sleep(0.5)
+
+    h1 = _sched(tmp_path, 1, lease_timeout_s=0.3, heartbeat_s=30.0)
+    l1 = h1.next_lease(units, poll_s=0.01)
+    assert l1 is not None and l1.stolen and l1.generation == 2
+    assert h1.stats["lease_expirations"] == 1
+    assert h1.stats["leases_steal"] == 1
+    # generation fencing: the original holder must discard its result
+    assert not h0.still_current(l0)
+    assert h1.still_current(l1)
+    h1.mark_done(l1)
+    assert h0.next_lease(units, poll_s=0.01) is None
+    h1.close()
+
+
+def test_heartbeat_keeps_a_slow_build_leased(tmp_path):
+    u = _unit("slow-m0")
+    units = {u.unit_id: u}
+    h0 = _sched(tmp_path, 0, lease_timeout_s=0.4, heartbeat_s=0.1)
+    l0 = h0.next_lease(units, poll_s=0.01)
+    time.sleep(0.8)  # two timeouts pass, but the heartbeat refreshes mtime
+    h1 = _sched(tmp_path, 1, lease_timeout_s=0.4, heartbeat_s=0.1)
+    # nothing stealable and nothing unleased: the peer sees no candidate
+    start = time.time()
+    got = []
+    while time.time() - start < 0.5 and not got:
+        cur = h1._current_lease(u.unit_id)
+        assert cur is not None
+        gen, _, age = cur
+        if age > h1.lease_timeout_s:
+            got.append(gen)
+        time.sleep(0.05)
+    assert not got, "heartbeated lease went stale"
+    assert h0.still_current(l0)
+    h0.mark_done(l0)
+    h0.close(), h1.close()
+
+
+def _units_by_owner(num_hosts=2, per_owner=2):
+    units, by_owner = {}, {r: [] for r in range(num_hosts)}
+    i = 0
+    while any(len(v) < per_owner for v in by_owner.values()):
+        uid = unit_id_for([f"share-m{i}"])
+        owner = zlib.crc32(uid.encode()) % num_hosts
+        if len(by_owner[owner]) < per_owner:
+            units[uid] = WorkUnit(uid, (f"share-m{i}",))
+            by_owner[owner].append(uid)
+        i += 1
+    return units, by_owner
+
+
+def test_static_policy_never_touches_peer_share(tmp_path):
+    units, by_owner = _units_by_owner()
+    h0 = _sched(tmp_path, 0, policy="static")
+    drained = []
+    while True:
+        lease = h0.next_lease(units, poll_s=0.01)
+        if lease is None:
+            break
+        drained.append(lease.unit.unit_id)
+        h0.mark_done(lease)
+    h0.close()
+    # own share fully built; peer share untouched AND not waited on
+    assert sorted(drained) == sorted(by_owner[0])
+    assert h0.stats["leases_steal"] == 0
+    for uid in by_owner[1]:
+        assert not h0.is_done(uid)
+
+
+def test_static_policy_releases_its_own_ghost_lease(tmp_path):
+    """A crashed prior attempt of the SAME host leaves a stale lease on its
+    own share; static mode must re-lease it rather than deadlock."""
+    units, by_owner = _units_by_owner(per_owner=1)
+    uid = by_owner[0][0]
+    ghost = _sched(tmp_path, 0, policy="static", lease_timeout_s=0.3,
+                   heartbeat_s=30.0)
+    l_ghost = ghost.next_lease(units, poll_s=0.01)
+    assert l_ghost.unit.unit_id == uid
+    ghost.close()  # crash stand-in: lease never marked done
+    time.sleep(0.5)
+
+    again = _sched(tmp_path, 0, policy="static", lease_timeout_s=0.3,
+                   heartbeat_s=30.0)
+    lease = again.next_lease(units, poll_s=0.01)
+    assert lease is not None and lease.unit.unit_id == uid
+    assert lease.generation == 2
+    # re-leasing your own ghost is not a steal and not a peer expiry
+    assert not lease.stolen
+    assert again.stats["lease_expirations"] == 0
+    assert again.stats["leases_steal"] == 0
+    again.mark_done(lease)
+    again.close()
+
+
+def test_placement_prefers_compiled_signature_then_lpt(tmp_path):
+    big = WorkUnit(unit_id_for(["lpt-big"]), ("lpt-big",),
+                   signature="SIG-COLD", cost=8)
+    small = WorkUnit(unit_id_for(["lpt-small"]), ("lpt-small",),
+                     signature="SIG-WARM", cost=1)
+    units = {big.unit_id: big, small.unit_id: small}
+
+    # cold host: LPT — biggest unit first
+    cold = _sched(tmp_path / "cold", 0, num_hosts=1)
+    lease = cold.next_lease(units, poll_s=0.01)
+    assert lease.unit.unit_id == big.unit_id
+    cold.mark_done(lease)
+    cold.close()
+
+    # host that already compiled the small unit's signature takes it first
+    # even though the big unit wins on LPT
+    warm = _sched(tmp_path / "warm", 0, num_hosts=1)
+    warm.note_compiled("SIG-WARM")
+    lease = warm.next_lease(units, poll_s=0.01)
+    assert lease.unit.unit_id == small.unit_id
+    warm.mark_done(lease)
+    warm.close()
+
+
+def test_scheduler_dir_for_env_override(tmp_path, monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_SCHEDULER_DIR", raising=False)
+    assert scheduler_dir_for("/out") == "/out/_scheduler"
+    monkeypatch.setenv("GORDO_TPU_SCHEDULER_DIR", str(tmp_path))
+    assert scheduler_dir_for("/out") == str(tmp_path)
+
+
+def test_rejects_unknown_policy(tmp_path):
+    with pytest.raises(ValueError):
+        ElasticScheduler(str(tmp_path), policy="chaotic")
+
+
+# ------------------------------------------------- in-process elastic builds
+def _machine_config(name, end="2019-01-03T00:00:00+00:00"):
+    return {
+        "name": name,
+        "dataset": {
+            "type": "RandomDataset",
+            "train_start_date": "2019-01-01T00:00:00+00:00",
+            "train_end_date": end,
+            "tags": [f"{name}-tag-a", f"{name}-tag-b"],
+        },
+        "model": {
+            "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.models.models.AutoEncoder": {
+                        "kind": "feedforward_hourglass",
+                        "epochs": 1,
+                    }
+                }
+            }
+        },
+    }
+
+
+def _machines(names, **overrides):
+    from gordo_tpu.machine import Machine
+
+    return [
+        Machine.from_config(
+            _machine_config(n, **overrides.get(n, {})), project_name="elastic-test"
+        )
+        for n in names
+    ]
+
+
+def test_elastic_build_requires_shared_state():
+    from gordo_tpu.parallel import BatchedModelBuilder
+
+    builder = BatchedModelBuilder(
+        _machines(["es-m0"]), elastic=True, warm_start=False
+    )
+    with pytest.raises(ValueError, match="shared state"):
+        builder.build()
+
+
+def test_elastic_build_cache_rerun_and_warm_start_delta(tmp_path):
+    """The three-run acceptance cycle on one host:
+
+    1. cold elastic build of 3 machines — every unit leased and done;
+    2. rerun of the unchanged fleet — 0 retrained, all 3 returned from
+       exactly-once cache claims, no leases taken;
+    3. one machine's data window perturbed — exactly 1 machine retrains,
+       and it warm-starts from the prior artifact's params.
+    """
+    from gordo_tpu.observability import metrics as mc
+    from gordo_tpu.parallel import BatchedModelBuilder
+
+    names = ["el-m0", "el-m1", "el-m2"]
+    reg = str(tmp_path / "registry")
+
+    out1 = str(tmp_path / "run1")
+    b1 = BatchedModelBuilder(
+        _machines(names), output_dir=out1, model_register_dir=reg,
+        elastic=True, host_rank=0, num_hosts=1,
+    )
+    r1 = b1.build()
+    assert sorted(m.name for _, m in r1) == names
+    assert b1.scheduler is not None
+    s1 = b1.scheduler.stats
+    assert s1["leases_fresh"] + s1["leases_steal"] >= 1
+    assert s1["lease_expirations"] == 0
+    done_dir = os.path.join(out1, "_scheduler", "done")
+    assert any(n.endswith(".json") for n in os.listdir(done_dir))
+    for n in names:
+        assert os.path.exists(os.path.join(out1, n, "model.pkl"))
+
+    # unchanged rerun (fresh output_dir, shared registry): retrains 0
+    out2 = str(tmp_path / "run2")
+    b2 = BatchedModelBuilder(
+        _machines(names), output_dir=out2, model_register_dir=reg,
+        elastic=True, host_rank=0, num_hosts=1,
+    )
+    r2 = b2.build()
+    assert sorted(m.name for _, m in r2) == names
+    s2 = b2.scheduler.stats
+    assert s2["claims"] == 3  # every machine returned via a cache claim
+    assert s2["leases_fresh"] + s2["leases_steal"] == 0  # nothing retrained
+
+    # perturb ONE machine's data window: full cache key misses, warm key
+    # (data excluded) hits — exactly one retrain, warm-started
+    warm_before = mc.WARM_STARTS.value()
+    out3 = str(tmp_path / "run3")
+    b3 = BatchedModelBuilder(
+        _machines(names, **{"el-m0": {"end": "2019-01-04T00:00:00+00:00"}}),
+        output_dir=out3, model_register_dir=reg,
+        elastic=True, host_rank=0, num_hosts=1,
+    )
+    r3 = b3.build()
+    assert sorted(m.name for _, m in r3) == names
+    s3 = b3.scheduler.stats
+    assert s3["claims"] == 2  # the two unchanged machines
+    assert s3["leases_fresh"] + s3["leases_steal"] == 1  # one rebuilt unit
+    assert mc.WARM_STARTS.value() - warm_before == 1
+    assert os.path.exists(os.path.join(out3, "el-m0", "model.pkl"))
+
+
+# ------------------------------------------------------ 2-process chaos test
+N_CHAOS = 4
+
+CHAOS_CONFIG = {
+    "machines": [
+        {
+            "name": f"chaos-m{i}",
+            "dataset": {
+                "type": "RandomDataset",
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                # two distinct windows -> two row counts -> two buckets,
+                # so there is a unit left to steal after the victim dies
+                "train_end_date": (
+                    "2019-01-02T00:00:00+00:00"
+                    if i < 2
+                    else "2019-01-03T00:00:00+00:00"
+                ),
+                "tags": [f"chaos-{i}-a", f"chaos-{i}-b"],
+            },
+            "model": {
+                "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        "gordo_tpu.models.models.AutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "epochs": 1,
+                        }
+                    }
+                }
+            },
+        }
+        for i in range(N_CHAOS)
+    ]
+}
+
+CHAOS_WORKER = """
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+import yaml
+from gordo_tpu.machine import Machine
+from gordo_tpu.parallel import BatchedModelBuilder
+
+rank = int(sys.argv[1])
+outdir = sys.argv[2]
+mode = sys.argv[3]  # "elastic" | "single"
+
+with open(os.path.join(outdir, "config.yaml")) as f:
+    config = yaml.safe_load(f)
+machines = [
+    Machine.from_config(c, project_name="chaos") for c in config["machines"]
+]
+
+kw = dict(
+    output_dir=os.path.join(outdir, "models"),
+    model_register_dir=os.path.join(outdir, "registry"),
+    warm_start=False,
+)
+if mode == "elastic":
+    kw.update(elastic=True, host_rank=rank, num_hosts=2)
+builder = BatchedModelBuilder(machines, **kw)
+results = builder.build()
+stats = dict(builder.scheduler.stats) if builder.scheduler else {{}}
+print("STATS " + json.dumps({{
+    "rank": rank,
+    "built": sorted(m.name for _, m in results),
+    "stats": stats,
+}}), flush=True)
+"""
+
+
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _spawn_chaos_worker(worker_py, rank, outdir, mode, env):
+    return subprocess.Popen(
+        [sys.executable, worker_py, str(rank), outdir, mode],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_chaos_host_death_is_stolen_and_artifacts_are_byte_stable():
+    """Kill host 0 at its first lease (``scheduler_lease``/``die`` fault
+    rule -> os._exit(17)); host 1 must finish the whole fleet, recording
+    at least one expiry-steal; the artifact set must equal a plain
+    single-host build byte-for-byte (training is deterministic and
+    device-count-invariant is NOT assumed: both arms run 4 virtual
+    devices)."""
+    outdir = tempfile.mkdtemp(prefix="gordo-chaos-")
+    elastic_dir = os.path.join(outdir, "elastic")
+    baseline_dir = os.path.join(outdir, "baseline")
+    for d in (elastic_dir, baseline_dir):
+        os.makedirs(d)
+        with open(os.path.join(d, "config.yaml"), "w") as f:
+            yaml.safe_dump(CHAOS_CONFIG, f)
+    worker_py = os.path.join(outdir, "chaos_worker.py")
+    with open(worker_py, "w") as f:
+        f.write(CHAOS_WORKER.format(repo=REPO))
+
+    env_base = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("XLA_FLAGS") and not k.startswith("GORDO_TPU_")
+    }
+    chaos_env = dict(
+        env_base,
+        GORDO_TPU_LEASE_TIMEOUT_S="2",
+        GORDO_TPU_HEARTBEAT_S="0.5",
+    )
+    victim_env = dict(
+        chaos_env,
+        GORDO_TPU_HOST_ID="victim",
+        GORDO_TPU_FAULT_PLAN=json.dumps(
+            {"rules": [{"site": "scheduler_lease", "error": "die"}]}
+        ),
+    )
+    survivor_env = dict(chaos_env, GORDO_TPU_HOST_ID="survivor")
+
+    # baseline builds concurrently; victim leases a unit and hard-exits
+    baseline = _spawn_chaos_worker(worker_py, 0, baseline_dir, "single", env_base)
+    victim = _spawn_chaos_worker(worker_py, 0, elastic_dir, "elastic", victim_env)
+    v_out, _ = victim.communicate(timeout=600)
+    assert victim.returncode == 17, f"victim did not die at the fault:\n{v_out[-4000:]}"
+
+    # the survivor starts against the victim's now-stale lease
+    survivor = _spawn_chaos_worker(
+        worker_py, 1, elastic_dir, "elastic", survivor_env
+    )
+    s_out, _ = survivor.communicate(timeout=600)
+    assert survivor.returncode == 0, f"survivor failed:\n{s_out[-4000:]}"
+    b_out, _ = baseline.communicate(timeout=600)
+    assert baseline.returncode == 0, f"baseline failed:\n{b_out[-4000:]}"
+
+    stats_lines = [l for l in s_out.splitlines() if l.startswith("STATS ")]
+    assert stats_lines, s_out[-4000:]
+    payload = json.loads(stats_lines[-1][len("STATS "):])
+    names = sorted(m["name"] for m in CHAOS_CONFIG["machines"])
+    # the survivor finished the victim's work: full fleet, >=1 expiry-steal
+    assert payload["built"] == names
+    assert payload["stats"]["lease_expirations"] >= 1
+    assert payload["stats"]["leases_steal"] >= 1
+
+    for name in names:
+        stolen_pkl = os.path.join(elastic_dir, "models", name, "model.pkl")
+        base_pkl = os.path.join(baseline_dir, "models", name, "model.pkl")
+        assert os.path.exists(stolen_pkl), f"{name}: missing elastic artifact"
+        assert os.path.exists(base_pkl), f"{name}: missing baseline artifact"
+        assert _sha256(stolen_pkl) == _sha256(base_pkl), (
+            f"{name}: elastic artifact differs from single-host build"
+        )
