@@ -12,7 +12,11 @@ but this harness goes where locust's default accounting doesn't:
   from its *intended* send time on a fixed schedule, so a server stall
   shows up as queueing delay in p99 instead of silently pausing the
   request stream (coordinated omission). Workers are a concurrency cap,
-  not the request clock.
+  not the request clock. ``--processes N`` forks the generator into N
+  processes that stride-slice the same global schedule (child k takes
+  arrival indices ``i ≡ k mod N``) and merge their log-bucketed
+  histograms exactly — for rates where one GIL-bound client process
+  saturates before the server does.
 - **Closed-loop mode** (``--mode closed``, the default) is the classic
   N-users-in-a-loop driver; ``--expected-interval-ms`` optionally applies
   the HdrHistogram back-fill correction to its recordings.
@@ -39,6 +43,7 @@ Usage:
 import argparse
 import heapq
 import json
+import os
 import sys
 import threading
 import time
@@ -238,6 +243,131 @@ def run_open(
     return stats_list, max(wall, duration, 1e-9)
 
 
+# --------------------------------------------- multi-process open loop
+def _stats_to_dict(stats: WorkerStats) -> dict:
+    """JSON-safe snapshot of one worker's accounting for the pipe back to
+    the parent (histograms via their own to_dict)."""
+    return {
+        "hist": stats.hist.to_dict(),
+        "phase_hists": {
+            name: hist.to_dict() for name, hist in stats.phase_hists.items()
+        },
+        "errors": stats.errors,
+        "slowest": stats.slowest,
+        "requests": stats.requests,
+        "warmup_requests": stats.warmup_requests,
+    }
+
+
+def _stats_from_dict(payload: dict, top_slow: int = DEFAULT_TOP_SLOW):
+    from gordo_tpu.observability.latency import LatencyHistogram as _LH
+
+    stats = WorkerStats(top_slow)
+    stats.hist = _LH.from_dict(payload["hist"])
+    stats.phase_hists = {
+        name: _LH.from_dict(doc)
+        for name, doc in payload.get("phase_hists", {}).items()
+    }
+    stats.errors = list(payload.get("errors", []))
+    stats.slowest = [tuple(item) for item in payload.get("slowest", [])]
+    stats.requests = int(payload.get("requests", 0))
+    stats.warmup_requests = int(payload.get("warmup_requests", 0))
+    return stats
+
+
+def run_open_processes(
+    send, users: int, qps: float, duration: float, warmup: float = 0.0,
+    processes: int = 2, top_slow: int = DEFAULT_TOP_SLOW,
+):
+    """Open-loop QPS across ``processes`` forked generator processes.
+
+    A single CPython process tops out near 25k samples/s of generated load
+    on this class of box — the GIL serializes request encoding and socket
+    writes, so past that point the *client* is the bottleneck and the
+    measurement is of the harness, not the server. Forking moves the
+    schedule onto independent interpreters: child ``k`` owns exactly the
+    arrival indices ``i ≡ k (mod processes)`` of the one global schedule
+    ``t0 + i/qps``, so the union of children reproduces the single-process
+    schedule *exactly* — same intended send times, same
+    coordinated-omission-safe accounting — and the per-worker log-bucketed
+    histograms merge losslessly in the parent
+    (``LatencyHistogram.merge`` is associative by design; bucket counts
+    add, no resampling). ``t0`` is CLOCK_MONOTONIC, which is system-wide
+    on Linux, so intended times agree across the fork boundary.
+    """
+    total = max(1, int(round((warmup + duration) * qps)))
+    first_measured = int(round(warmup * qps))
+    # small lead so every child observes the schedule start in its future
+    t0 = time.monotonic() + 0.25
+
+    def child_open_loop(k: int):
+        stats_list = [WorkerStats(top_slow) for _ in range(users)]
+        lock = threading.Lock()
+        next_stride = [0]
+
+        def worker(stats):
+            while True:
+                with lock:
+                    j = next_stride[0]
+                    next_stride[0] += 1
+                i = k + j * processes
+                if i >= total:
+                    return
+                intended = t0 + i / qps
+                now = time.monotonic()
+                if intended > now:
+                    time.sleep(intended - now)
+                error, trace_id, phases = send()
+                latency = time.monotonic() - intended
+                stats.observe(
+                    latency, error, trace_id, phases,
+                    measured=i >= first_measured,
+                )
+
+        _run_threads(worker, stats_list)
+        return stats_list
+
+    children = []
+    for k in range(processes):
+        read_fd, write_fd = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            os.close(read_fd)
+            code = 0
+            try:
+                payload = json.dumps(
+                    [_stats_to_dict(s) for s in child_open_loop(k)]
+                ).encode()
+                with os.fdopen(write_fd, "wb") as pipe:
+                    pipe.write(payload)
+            except BaseException:  # noqa: BLE001 — child must never unwind
+                code = 1
+            os._exit(code)
+        os.close(write_fd)
+        children.append((pid, read_fd))
+
+    stats_list = []
+    failed_children = 0
+    for pid, read_fd in children:
+        with os.fdopen(read_fd, "rb") as pipe:
+            data = pipe.read()
+        os.waitpid(pid, 0)
+        try:
+            stats_list.extend(
+                _stats_from_dict(doc, top_slow) for doc in json.loads(data)
+            )
+        except (ValueError, KeyError):
+            failed_children += 1
+    if failed_children:
+        broken = WorkerStats(top_slow)
+        broken.errors.append(
+            f"{failed_children} generator process(es) died without reporting"
+        )
+        stats_list.append(broken)
+    wall = time.monotonic() - (t0 + warmup)
+    return stats_list, max(wall, duration, 1e-9)
+
+
 def _ms(value):
     return None if value is None else round(value * 1e3, 3)
 
@@ -365,7 +495,8 @@ def run(
     users: int = 8, duration: float = 30.0, warmup: float = 0.0,
     qps: float = None, ramp_users=None, samples: int = 100,
     codec: str = None, expected_interval_ms: float = None,
-    flight: bool = True, top_slow: int = DEFAULT_TOP_SLOW, _send=None,
+    flight: bool = True, top_slow: int = DEFAULT_TOP_SLOW,
+    processes: int = 1, _send=None,
 ) -> dict:
     """One full load run against a live server; returns the report dict.
     ``_send`` injects a fake transport for tests."""
@@ -400,9 +531,15 @@ def run(
     if mode == "qps":
         if not qps or qps <= 0:
             return {"error": "--mode qps requires --qps > 0"}
-        stats_list, wall = run_open(
-            send, users, qps, duration, warmup, top_slow
-        )
+        if processes > 1:
+            stats_list, wall = run_open_processes(
+                send, users, qps, duration, warmup, processes, top_slow
+            )
+            report["processes"] = processes
+        else:
+            stats_list, wall = run_open(
+                send, users, qps, duration, warmup, top_slow
+            )
         report["qps_target"] = qps
         report.update(summarize(stats_list, wall, samples, top_slow))
         all_slowest = report["slowest"]
@@ -457,6 +594,12 @@ def main(argv=None) -> int:
     parser.add_argument("--qps", type=float, default=None,
                         help="open-loop request rate for --mode qps")
     parser.add_argument(
+        "--processes", type=int, default=1,
+        help="fork this many generator processes for --mode qps: child k "
+        "owns schedule indices i ≡ k (mod N), histograms merge exactly — "
+        "use when a single GIL-bound client saturates before the server",
+    )
+    parser.add_argument(
         "--ramp-users", default="1,2,4,8",
         help="comma-separated concurrency steps for --mode ramp",
     )
@@ -495,6 +638,7 @@ def main(argv=None) -> int:
         samples=args.samples, codec=args.codec,
         expected_interval_ms=args.expected_interval_ms,
         flight=not args.no_flight, top_slow=args.top_slow,
+        processes=args.processes,
     )
     print(json.dumps(report))
     if "error" in report:

@@ -267,6 +267,19 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         anomaly-confidence and total-anomaly-confidence
         (reference diff.py:320-462).
         """
+        return self.anomaly_raw(X, y, frequency=frequency).to_pandas()
+
+    def anomaly_raw(
+        self,
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray],
+        frequency: Optional[timedelta] = None,
+    ) -> model_utils.RawFrame:
+        """
+        ``anomaly`` minus the pandas assembly: the same column groups as an
+        unassembled :class:`RawFrame`, which the serving fast codec encodes
+        directly (``to_pandas`` yields the exact ``anomaly`` frame).
+        """
         # predict on the raw float64 array, not the DataFrame: sklearn
         # re-validates frame inputs per call (feature-name checks, column
         # realignment — ~0.6 ms on the serve path) and our estimators are
@@ -303,19 +316,17 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
             else [str(i) for i in range(model_output.shape[1])]
         )
 
-        tuples = [("start", ""), ("end", "")]
-        blocks = [model_input, model_output]
-        tuples += [("model-input", name) for name in in_names]
-        tuples += [("model-output", name) for name in out_names]
+        groups = [
+            ("model-input", in_names, model_input),
+            ("model-output", out_names, model_output),
+        ]
 
         def add_block(top, values, subs=out_names):
             values = np.asarray(values)
             if values.ndim == 1:
-                tuples.append((top, ""))
-                blocks.append(values[:, None])
+                groups.append((top, ("",), values[:, None]))
             else:
-                tuples.extend((top, sub) for sub in subs)
-                blocks.append(values)
+                groups.append((top, subs, values))
 
         add_block("tag-anomaly-scaled", tag_anomaly_scaled)
         add_block("total-anomaly-scaled", total_anomaly_scaled)
@@ -354,9 +365,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
                 f"these thresholds before calling `.anomaly`"
             )
 
-        return model_utils.assemble_multiindex_frame(
-            tuples, blocks, index, frequency
-        )
+        return model_utils.RawFrame(groups, index, frequency)
 
 
 class DiffBasedKFCVAnomalyDetector(DiffBasedAnomalyDetector):

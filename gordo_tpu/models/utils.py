@@ -64,6 +64,87 @@ def metric_wrapper(metric, scaler=None):
     return _wrapper
 
 
+class RawFrame:
+    """Unassembled response frame: named column groups over one shared
+    index. The serve path builds this instead of a pandas DataFrame so the
+    fast codec can encode straight from the numeric blocks; ``to_pandas``
+    assembles (and caches) the exact frame the pandas path would have
+    produced — both representations come from the same group list, so the
+    payload shapes cannot drift."""
+
+    __slots__ = ("groups", "index", "frequency", "_df")
+
+    def __init__(self, groups, index, frequency: Optional[timedelta] = None):
+        # groups: [(top_name, sub_names, values)] with values shaped
+        # (n_rows, len(sub_names)); scalar groups use sub_names ("",)
+        self.groups = groups
+        self.index = index
+        self.frequency = frequency
+        self._df = None
+
+    def top_levels(self):
+        return [top for top, _, _ in self.groups]
+
+    def drop_top_level(self, names) -> "RawFrame":
+        """Raw equivalent of ``df.drop(columns=names, level=0)``."""
+        dropped = set(names)
+        return RawFrame(
+            [g for g in self.groups if g[0] not in dropped],
+            self.index,
+            self.frequency,
+        )
+
+    def to_pandas(self) -> pd.DataFrame:
+        if self._df is None:
+            tuples = [("start", ""), ("end", "")]
+            blocks = []
+            for top, subs, values in self.groups:
+                tuples.extend((top, sub) for sub in subs)
+                blocks.append(values)
+            self._df = assemble_multiindex_frame(
+                tuples, blocks, self.index, self.frequency
+            )
+        return self._df
+
+
+def make_base_raw(
+    tags: Union[List[SensorTag], List[str]],
+    model_input: np.ndarray,
+    model_output: np.ndarray,
+    target_tag_list: Optional[Union[List[SensorTag], List[str]]] = None,
+    index: Optional[np.ndarray] = None,
+    frequency: Optional[timedelta] = None,
+) -> RawFrame:
+    """
+    ``make_base_dataframe`` without the pandas assembly: the canonical
+    'model-input'/'model-output' response groups as a :class:`RawFrame`,
+    aligning lengths when the model output fewer rows than it was given.
+    """
+    target_tag_list = target_tag_list if target_tag_list is not None else tags
+
+    model_input = getattr(model_input, "values", model_input)[-len(model_output):, :]
+    model_output = getattr(model_output, "values", model_output)
+
+    index = (
+        index[-len(model_output):]
+        if index is not None
+        else pd.RangeIndex(len(model_output))
+    )
+
+    groups = []
+    for name, values in (("model-input", model_input), ("model-output", model_output)):
+        _tags = tags if name == "model-input" else target_tag_list
+        if values.shape[1] == len(_tags):
+            subs = [
+                str(tag.name if isinstance(tag, SensorTag) else tag) for tag in _tags
+            ]
+        else:
+            subs = [str(i) for i in range(values.shape[1])]
+        groups.append((name, subs, values))
+
+    return RawFrame(groups, index, frequency)
+
+
 def make_base_dataframe(
     tags: Union[List[SensorTag], List[str]],
     model_input: np.ndarray,
@@ -77,32 +158,9 @@ def make_base_dataframe(
     columns and 'model-input'/'model-output' blocks, aligning lengths when the
     model output fewer rows than it was given.
     """
-    target_tag_list = target_tag_list if target_tag_list is not None else tags
-
-    model_input = getattr(model_input, "values", model_input)[-len(model_output):, :]
-    model_output = getattr(model_output, "values", model_output)
-
-    index = (
-        index[-len(model_output):]
-        if index is not None
-        else pd.RangeIndex(len(model_output))
-    )
-
-    # assemble once: time columns + a single numeric block, no joins
-    tuples = [("start", ""), ("end", "")]
-    for name, values in (("model-input", model_input), ("model-output", model_output)):
-        _tags = tags if name == "model-input" else target_tag_list
-        if values.shape[1] == len(_tags):
-            subs = [
-                str(tag.name if isinstance(tag, SensorTag) else tag) for tag in _tags
-            ]
-        else:
-            subs = [str(i) for i in range(values.shape[1])]
-        tuples.extend((name, sub) for sub in subs)
-
-    return assemble_multiindex_frame(
-        tuples, [model_input, model_output], index, frequency
-    )
+    return make_base_raw(
+        tags, model_input, model_output, target_tag_list, index, frequency
+    ).to_pandas()
 
 
 def assemble_multiindex_frame(

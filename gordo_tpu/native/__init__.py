@@ -45,6 +45,7 @@ _FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
+_encode_tpl_fn = None  # PYFUNCTYPE binding, set by _load()
 _load_failed = False
 _builder_thread: Optional[threading.Thread] = None
 _so_path_cache: Optional[str] = None
@@ -193,6 +194,32 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int64,
         ]
+        lib.gordo_parse_xy.restype = ctypes.c_int32
+        lib.gordo_parse_xy.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        # the template encoder calls CPython's own float formatter, which
+        # allocates via PyMem and therefore needs the GIL held; PYFUNCTYPE
+        # (unlike plain CDLL attribute access) does not release the GIL
+        # around the call
+        encode_proto = ctypes.PYFUNCTYPE(
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_char),
+            ctypes.c_int64,
+        )
+        global _encode_tpl_fn
+        _encode_tpl_fn = encode_proto(("gordo_encode_tpl", lib))
         _lib = lib
         return _lib
 
@@ -265,3 +292,78 @@ def rolling_min_max(values: np.ndarray, window: int) -> float:
             window,
         )
     )
+
+
+def parse_xy(body: bytes):
+    """
+    Strict one-pass parse of a ``{"X": [[...]], "y": [[...]]}`` request body
+    straight into float64 matrices, skipping json.loads + np.asarray.
+
+    Returns ``(X, y)`` ndarrays (``y`` None when absent/null), or None
+    when the body doesn't match the strict grammar — the caller must then
+    fall back to the json.loads path, which is always parity-safe.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if not isinstance(body, bytes):
+        body = bytes(body)
+    n = len(body)
+    # every value costs >= 2 body bytes ("[1," / ",1"), so this bounds
+    # the total element count across X and y
+    cap = n // 2 + 8
+    xbuf = np.empty(cap, dtype=np.float64)
+    ybuf = np.empty(cap, dtype=np.float64)
+    xshape = (ctypes.c_int64 * 2)()
+    yshape = (ctypes.c_int64 * 2)()
+    rc = lib.gordo_parse_xy(
+        body,
+        n,
+        xbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cap,
+        xshape,
+        ybuf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cap,
+        yshape,
+    )
+    if rc != 1:
+        return None
+    X = xbuf[: xshape[0] * xshape[1]].reshape(xshape[0], xshape[1])
+    y = None
+    if yshape[0] >= 0:
+        y = ybuf[: yshape[0] * yshape[1]].reshape(yshape[0], yshape[1])
+    return X, y
+
+
+def encode_template(
+    template: bytes, pre_lens: np.ndarray, values: np.ndarray
+) -> Optional[bytes]:
+    """
+    Render a JSON fragment by interleaving ``template`` byte chunks with
+    repr-formatted doubles (CPython's own formatter, so output is
+    byte-identical to json.dumps). ``pre_lens`` is int32 with
+    ``len(values) + 1`` entries; non-finite values render as ``null``.
+    Returns None when the native library is unavailable or rendering fails.
+    """
+    if _load() is None or _encode_tpl_fn is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    pre_lens = np.ascontiguousarray(pre_lens, dtype=np.int32)
+    if len(pre_lens) != len(values) + 1:
+        raise ValueError(
+            f"pre_lens must have len(values)+1 entries: "
+            f"{len(pre_lens)} vs {len(values)} values"
+        )
+    cap = len(template) + 32 * len(values) + 64
+    out = ctypes.create_string_buffer(cap)
+    written = _encode_tpl_fn(
+        template,
+        pre_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(values),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out,
+        cap,
+    )
+    if written <= 0:
+        return None
+    return ctypes.string_at(out, written)

@@ -213,6 +213,23 @@ FAST_CODEC_FALLBACK = telemetry.counter(
     "non-canonical response frames), by op",
     ("op",),
 )
+# ----------------------------------------- event-loop fast lane (ISSUE 11)
+# wired by server/fastlane.py (both the selectors event loop and the
+# thread-per-connection fallback lane) and ops/train.py
+FASTLANE_IDLE_CLOSES = telemetry.counter(
+    "gordo_server_fastlane_idle_closes_total",
+    "Keep-alive connections the fast lane closed for sitting idle between "
+    "requests past GORDO_TPU_FASTLANE_IDLE_S (event-loop sweep or thread "
+    "lane socket timeout); mid-request stalls are governed separately by "
+    "the request timeout",
+)
+TRACE_COMPILES = telemetry.counter(
+    "gordo_server_trace_compiles_total",
+    "jit trace+compile events in the serving path (incremented inside the "
+    "traced function bodies, which only execute while tracing); warmup "
+    "AOT pre-lowering exists to pay these before traffic, so a non-zero "
+    "steady-state rate means requests are eating compile walls",
+)
 # ------------------------------------------------ flight recorder (PR 5)
 # wired by observability/flight.py; read back through /debug/flight
 FLIGHT_RECORDED = telemetry.counter(

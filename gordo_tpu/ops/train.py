@@ -487,6 +487,20 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def note_trace_compile() -> None:
+    """Mark one serving-path jit trace+compile.
+
+    Called from INSIDE the traced function bodies — Python only executes
+    those while jax traces, once per compiled program variant — so the
+    counter (``gordo_server_trace_compiles_total``) prices exactly the
+    trace+compile events the serving path paid. Warmup/AOT pre-lowering
+    (server/warmup.py) exists to pay them all before traffic: steady
+    state must read a flat 0."""
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    metric_catalog.TRACE_COMPILES.inc()
+
+
 @functools.lru_cache(maxsize=256)
 def _build_predictor(spec: ModelSpec):
     @functools.lru_cache(maxsize=32)
@@ -494,12 +508,14 @@ def _build_predictor(spec: ModelSpec):
         if spec.lookback_window <= 1 and spec.lookahead == 0:
 
             def run(params, X):
+                note_trace_compile()
                 out, _ = apply_model(spec, params, X)
                 return out
 
         else:
 
             def run(params, X):
+                note_trace_compile()
                 idx = jnp.arange(n_pad)
                 window = jnp.arange(spec.lookback_window)
                 xb = X[idx[:, None] + window[None, :]]

@@ -37,10 +37,10 @@ re-enables a timed collection window if ever wanted.
 import functools
 import logging
 import os
-import queue
+import select
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -75,6 +75,136 @@ class _Item:
     # trace, parented here, with span-links to the co-fused riders — one
     # slow fuse then explains N slow requests
     trace_ctx: Any = None
+
+
+class _SubmitRing:
+    """Caller-side wait-free submit channel (many producers, one
+    dispatcher).
+
+    ``queue.Queue.put`` takes a mutex and signals a condition variable on
+    EVERY enqueue — pure overhead on the request thread, paid even when
+    the dispatcher is already awake draining. Here a producer publishes
+    with ONE atomic C-level operation (``deque.append`` executes as a
+    single opcode under the GIL, which is exactly the fetch-and-publish
+    a hardware MPSC ring buys with a CAS — multi-producer safety with no
+    lock, no spin, no condvar) and then pokes the dispatcher's single
+    eventfd-style wakeup ONLY when it is actually parked. The dispatcher
+    drains with non-blocking ``popleft``, spins briefly (yielding the
+    GIL) when the channel runs dry — steady-state arrivals land inside
+    the spin and skip the park/wake syscall pair entirely — and only
+    then parks on the fd.
+
+    Bounded: a producer observing ``capacity`` queued items sleeps a
+    tick and retries (admission control caps in-flight requests far
+    below any sane capacity, so this is a backstop against unbounded
+    memory, not a working backpressure path)."""
+
+    # dry-channel spins before parking; each iteration yields the GIL so
+    # producers can run (this box may be single-core)
+    SPINS = 100
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._q: "deque[_Item]" = deque()
+        self._parked = False
+        try:
+            fd = os.eventfd(0, os.EFD_NONBLOCK)  # type: ignore[attr-defined]
+            self._rfd = self._wfd = fd
+        except (AttributeError, OSError):  # pragma: no cover - non-Linux
+            self._rfd, self._wfd = os.pipe()
+            os.set_blocking(self._rfd, False)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    # ------------------------------------------------------------ producers
+    def put(self, item: "_Item") -> None:
+        q = self._q
+        while len(q) >= self.capacity:  # backstop, see class docstring
+            time.sleep(0.0005)
+        q.append(item)
+        # benign race with the dispatcher parking: it re-checks the deque
+        # AFTER raising its parked flag, so either it sees this item or it
+        # sees the flag-up write below — never a lost wakeup. A stale poke
+        # (dispatcher already drained the item) only costs one spurious
+        # pass through its drain loop.
+        if self._parked:
+            try:
+                os.write(self._wfd, b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            except OSError:  # pragma: no cover - fd closed at shutdown
+                pass
+
+    # ----------------------------------------------------------- dispatcher
+    def pop(self) -> Optional["_Item"]:
+        try:
+            return self._q.popleft()
+        except IndexError:
+            return None
+
+    def pop_wait(self, timeout: Optional[float] = None) -> Optional["_Item"]:
+        """One item, blocking: spin (GIL-yielding) then park on the fd.
+        ``None`` only when a timeout was given and expired."""
+        q = self._q
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return q.popleft()
+            except IndexError:
+                pass
+            for _ in range(self.SPINS):
+                time.sleep(0)
+                try:
+                    return q.popleft()
+                except IndexError:
+                    continue
+            self._parked = True
+            try:
+                # lost-wakeup guard: an item published before the flag
+                # went up would never poke the fd — look again first
+                try:
+                    return q.popleft()
+                except IndexError:
+                    pass
+                if deadline is None:
+                    select.select([self._rfd], [], [])
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return self.pop()
+                    select.select([self._rfd], [], [], remaining)
+                self._drain_fd()
+            finally:
+                self._parked = False
+
+    def _drain_fd(self) -> None:
+        # eventfd: one read returns-and-zeroes the whole counter; pipe
+        # fallback: a large read slurps every pending poke byte
+        try:
+            os.read(self._rfd, 65536)
+        except (BlockingIOError, InterruptedError):
+            pass
+
+
+# completion waiters, pooled per submitting thread: one reusable Event per
+# connection (thread lane maps connections 1:1 onto threads; the event-loop
+# lane's single thread reuses one) instead of a fresh Event allocated and
+# garbage-collected per predict. A waiter that ABANDONS its item must not
+# reuse the Event — the dispatcher may still set() it late — so the abandon
+# path drops the pooled instance and the next submit starts fresh.
+_waiter_pool = threading.local()
+
+
+def _checkout_waiter() -> threading.Event:
+    waiter = getattr(_waiter_pool, "event", None)
+    if waiter is None:
+        waiter = threading.Event()
+        _waiter_pool.event = waiter
+    waiter.clear()
+    return waiter
+
+
+def _discard_waiter() -> None:
+    _waiter_pool.event = None
 
 
 @functools.lru_cache(maxsize=1024)
@@ -123,6 +253,9 @@ def _stacked_apply(spec, n_pad: int, batch: int, capacity: int):
             return out
 
     def gathered(bank_params, model_idx, X):
+        from gordo_tpu.ops.train import note_trace_compile
+
+        note_trace_compile()
         params = jax.tree_util.tree_map(lambda a: a[model_idx], bank_params)
         return jax.vmap(one)(params, X)
 
@@ -140,16 +273,19 @@ def _single_apply(spec, n_pad: int):
     import jax.numpy as jnp
 
     from gordo_tpu.ops.nn import apply_model
+    from gordo_tpu.ops.train import note_trace_compile
 
     if spec.lookback_window <= 1 and spec.lookahead == 0:
 
         def one(params, X):
+            note_trace_compile()
             out, _ = apply_model(spec, params, X)
             return out
 
     else:
 
         def one(params, X):
+            note_trace_compile()
             idx = jnp.arange(n_pad)
             window = jnp.arange(spec.lookback_window)
             xb = X[idx[:, None] + window[None, :]]
@@ -293,7 +429,7 @@ class CrossModelBatcher:
             timeout_s = float(os.environ.get("GORDO_TPU_BATCH_TIMEOUT_S", "300"))
         # <=0 means wait without limit
         self.timeout_s = timeout_s if timeout_s > 0 else None
-        self._q: "queue.Queue[_Item]" = queue.Queue()
+        self._ring = _SubmitRing()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._banks: Dict[Any, _ParamBank] = {}
@@ -311,6 +447,12 @@ class CrossModelBatcher:
         # buffering — see _stacked_inputs); only the dispatcher thread
         # fills/ships them.
         self._stack_buffers: Dict[Tuple, list] = {}
+        # AOT pre-lowered serving programs (ISSUE 11): (spec, n_pad, b_pad,
+        # bank capacity) -> (expected X shape, compiled executable). Filled
+        # by prelower() at warmup; _device_call prefers these — calling a
+        # compiled executable never re-traces, so steady state keeps
+        # gordo_server_trace_compiles_total flat
+        self._aot: Dict[Tuple, Tuple[Tuple, Any]] = {}
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
@@ -349,6 +491,62 @@ class CrossModelBatcher:
         """Resident models in the spec's bank (0 when no bank exists)."""
         bank = self._banks.get(spec)
         return 0 if bank is None else len(bank)
+
+    def prelower(
+        self,
+        spec,
+        X_pad: np.ndarray,
+        n_pad: int,
+        fuse_widths: Tuple[int, ...] = (1, 4, 16, 64),
+    ) -> int:
+        """AOT pre-lower + compile the stacked serving programs for one
+        (spec, padded shape) across the fuse-width buckets real traffic
+        hits (``_device_call`` grows batches 1→4→16→64), via
+        ``jax.jit(...).lower(shapes).compile()`` over ShapeDtypeStructs —
+        no input arrays materialized, no device call executed.
+
+        Steady-state serving then runs entirely on these executables:
+        calling a compiled program never re-traces, so
+        ``gordo_server_trace_compiles_total`` stays flat once warmup is
+        done. Compiles land in the persistent XLA cache
+        (util/xla_cache.py) like any other, so a restarted worker
+        re-lowers but reloads the compiled artifact instead of paying XLA.
+
+        Requires the spec's param bank to be stacked already (warmup
+        registers params first); returns how many programs were
+        compiled. Best-effort: a failing width is logged and skipped —
+        the jit path serves it lazily instead."""
+        import jax
+
+        bank = self._banks.get(spec)
+        if bank is None or bank.stacked is None:
+            return 0
+        bank_shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), bank.stacked
+        )
+        compiled = 0
+        for width in fuse_widths:
+            b_pad = min(width, self.max_batch)
+            key = (spec, n_pad, b_pad, bank.capacity)
+            if key in self._aot:
+                continue
+            x_shape = (b_pad,) + X_pad.shape
+            try:
+                program = _stacked_apply(spec, n_pad, b_pad, bank.capacity)
+                executable = program.lower(
+                    bank_shapes,
+                    jax.ShapeDtypeStruct((b_pad,), np.int32),
+                    jax.ShapeDtypeStruct(x_shape, X_pad.dtype),
+                ).compile()
+            except Exception as exc:  # noqa: BLE001 — jit path still serves
+                logger.warning(
+                    "AOT pre-lower failed for (n_pad=%d, fuse=%d): %s",
+                    n_pad, b_pad, exc,
+                )
+                continue
+            self._aot[key] = (x_shape, executable)
+            compiled += 1
+        return compiled
 
     def submit(self, spec, params, X) -> Optional[np.ndarray]:
         """Blocking predict through the batch queue (thread-safe).
@@ -502,7 +700,8 @@ class CrossModelBatcher:
         from gordo_tpu.server import resilience
 
         X_pad, n_pad, n_keep = pad_for_predict(spec, X)
-        item = _Item(spec, params, X_pad, n_pad, n_keep)
+        item = _Item(spec, params, X_pad, n_pad, n_keep,
+                     done=_checkout_waiter())
         item.t_submit = time.monotonic()
         item.tag = resilience.current_model() or ""
         # budget already spent (e.g. decode ate it): never even queue
@@ -519,9 +718,13 @@ class CrossModelBatcher:
         # under, so the request's tree reads: request → queue → device call
         with telemetry.span("serve_batch_queue", model=item.tag):
             item.trace_ctx = tracing.capture()
-            self._q.put(item)
+            self._ring.put(item)
             if not item.done.wait(timeout=timeout):
                 item.abandoned = True
+                # the dispatcher may still set() this Event after we walk
+                # away — drop it from the pool so the late set lands on an
+                # orphan, never on this thread's NEXT item
+                _discard_waiter()
                 self._record_abandoned(item)
                 if deadline_bound:
                     resilience.record_deadline_exceeded("queue_wait")
@@ -569,7 +772,7 @@ class CrossModelBatcher:
 
     def _loop(self):
         while True:
-            batch = [self._q.get()]
+            batch = [self._ring.pop_wait()]
             if self.window_s > 0:
                 # optional timed collection window (off by default)
                 deadline = time.monotonic() + self.window_s
@@ -577,18 +780,18 @@ class CrossModelBatcher:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
-                    try:
-                        batch.append(self._q.get(timeout=remaining))
-                    except queue.Empty:
+                    nxt = self._ring.pop_wait(timeout=remaining)
+                    if nxt is None:
                         break
+                    batch.append(nxt)
             else:
                 # work-conserving: fuse exactly the requests that piled up
                 # while the previous device call ran; never wait for more
                 while len(batch) < self.max_batch:
-                    try:
-                        batch.append(self._q.get_nowait())
-                    except queue.Empty:
+                    nxt = self._ring.pop()
+                    if nxt is None:
                         break
+                    batch.append(nxt)
             self._run(batch)
 
     def _run(self, batch: List[_Item]):
@@ -733,11 +936,19 @@ class CrossModelBatcher:
             faults.fault_point(
                 "serve_device_call", machines=[it.tag for it in items]
             )
-            out = np.asarray(
-                _stacked_apply(spec, items[0].n_pad, b_pad, bank.capacity)(
-                    bank.stacked, idx, X
+            # AOT-first: a pre-lowered executable for this exact program
+            # never re-traces; shapes are double-checked because windowed
+            # specs can (pathologically) pad to more rows than the warmup
+            # exemplar — a mismatch quietly takes the jit path instead of
+            # failing the group into the recovery ladder
+            aot = self._aot.get((spec, items[0].n_pad, b_pad, bank.capacity))
+            if aot is not None and aot[0] == X.shape:
+                program = aot[1]
+            else:
+                program = _stacked_apply(
+                    spec, items[0].n_pad, b_pad, bank.capacity
                 )
-            )
+            out = np.asarray(program(bank.stacked, idx, X))
         except BaseException as exc:  # noqa: BLE001 — span then re-raise
             self._emit_device_span(items, t0, error=exc)
             raise

@@ -13,13 +13,12 @@ pandas path (asserted by tests/gordo_tpu/test_fast_codec.py):
   shape validation; no ``pd.DataFrame.from_dict``, no ``pd.concat``.
   Multi-level / ragged / non-numeric payloads return ``None`` and take the
   pandas path unchanged.
-- encode: a response frame serializes block-by-block off its float64
-  storage — index keys stringified once, NaN/Inf → ``null`` via one
-  vectorized ``np.isfinite`` pass, float columns written through the C
-  ``json`` encoder (identical shortest-repr formatting) instead of
-  ``to_numpy(dtype=object)`` + a recursive sanitize + generic dumps.
-  ``orjson`` is used for string escaping when importable; the stdlib C
-  escaper is the fallback (this image has no orjson wheel).
+- encode: a response frame (or an unassembled ``RawFrame`` straight off
+  the model, via :func:`encode_raw`) serializes off its numeric blocks —
+  the nested response dict is built with the exact ``dataframe_to_dict``
+  idioms (shared key list, NaN/Inf → ``None`` via one vectorized
+  ``np.isfinite`` pass) and emitted in one C ``json.dumps`` call, instead
+  of ``to_numpy(dtype=object)`` + a recursive sanitize + generic dumps.
 
 Gate: ``GORDO_TPU_FAST_CODEC`` (default **on**; ``0`` restores the pandas
 path exactly). Per-request override: ``X-Gordo-Codec: pandas|fast`` header
@@ -29,6 +28,7 @@ Usage is counted by ``gordo_server_fast_codec_total`` /
 ``gordo_server_fast_codec_fallback_total`` (bridged into ``/metrics``).
 """
 
+import functools
 import json
 import logging
 import os
@@ -38,16 +38,14 @@ import dateutil.parser
 import numpy as np
 import pandas as pd
 
+from gordo_tpu import native
+from gordo_tpu.models.utils import timestamp_columns
+
 logger = logging.getLogger(__name__)
 
-try:  # pragma: no cover - environment-dependent
-    from orjson import dumps as _orjson_dumps
-
-    def _escape(s: str) -> str:
-        return _orjson_dumps(s).decode()
-
-except ImportError:
-    from json.encoder import encode_basestring_ascii as _escape
+# json.dumps' own key/string escaper (C speed, ensure_ascii semantics) —
+# used to render template keys byte-identically to the dict path
+_escape = json.encoder.encode_basestring_ascii
 
 try:  # pragma: no cover - environment-dependent
     from orjson import loads as _loads
@@ -55,8 +53,6 @@ except ImportError:
     _loads = json.loads
 
 _dumps = json.dumps
-_add = str.__add__
-_join = ", ".join
 
 
 def loads(body):
@@ -171,28 +167,82 @@ def decode_dataframe(data) -> Optional[pd.DataFrame]:
     return frame
 
 
+def decode_body_xy(body):
+    """One native pass over a raw request body of exactly the shape
+    ``{"X": [[...]]}`` / ``{"X": ..., "y": ...}`` straight into float64
+    DataFrames — no ``json.loads``, no intermediate lists. Returns
+    ``(X, y_or_None)`` or ``None`` when the body doesn't match the strict
+    grammar (the caller then goes through ``loads`` + ``decode_dataframe``,
+    which is always parity-safe). The frames are exactly what
+    ``decode_dataframe`` yields for list-of-lists payloads: RangeIndex
+    rows and columns."""
+    if not isinstance(body, (bytes, bytearray, memoryview)):
+        return None
+    parsed = native.parse_xy(body if isinstance(body, bytes) else bytes(body))
+    if parsed is None:
+        return None
+    X_arr, y_arr = parsed
+    X = pd.DataFrame(X_arr)
+    y = pd.DataFrame(y_arr) if y_arr is not None else None
+    return X, y
+
+
 # ------------------------------------------------------------------- encode
-def _key_prefixes(index: pd.Index) -> Optional[List[str]]:
-    """Pre-escaped ``"<key>": `` fragments, one per row — computed once and
-    shared by every column (the pandas path re-builds a dict per column)."""
+#
+# Encoding builds the exact nested dict ``dataframe_to_dict`` would build
+# (same setdefault/zip idioms, NaN/Inf pre-substituted with None) and hands
+# it to the stdlib C encoder in ONE ``json.dumps`` call — measured faster
+# than stitching per-column fragments in Python, and byte-parity with
+# ``simplejson.dumps(..., ignore_nan=True)`` holds by construction: both
+# encoders emit identical separators, float reprs, and key coercions for
+# str/int keys and float/int/bool/str/None leaves. Column values come off
+# the frame's numeric blocks (or a RawFrame's raw blocks) via ``tolist``,
+# never through an object-dtype conversion.
+
+
+def _is_key(value) -> bool:
+    kind = type(value)
+    return kind is str or kind is int
+
+
+@functools.lru_cache(maxsize=64)
+def _range_keys(n: int) -> tuple:
+    """Pre-stringified "0".."n-1" index keys: every RangeIndex response of
+    n rows shares one tuple, and str keys dump measurably faster than the
+    encoder's int-key coercion (identical bytes either way)."""
+    return tuple(str(i) for i in range(n))
+
+
+def _index_keys(index: pd.Index) -> Optional[list]:
+    """Row keys exactly as ``dataframe_to_dict`` derives them."""
     if isinstance(index, pd.DatetimeIndex):
-        return [_escape(s) + ": " for s in index.astype(str)]
-    prefixes = []
-    for key in index.tolist():
-        kind = type(key)
-        if kind is int:
-            prefixes.append('"%d": ' % key)
-        elif kind is str:
-            prefixes.append(_escape(key) + ": ")
-        else:
+        return index.astype(str).tolist()
+    if isinstance(index, pd.RangeIndex) and index.start == 0 and index.step == 1:
+        return _range_keys(len(index))
+    keys = index.tolist()
+    for key in keys:
+        if not _is_key(key):
             return None
-    return prefixes
+    return keys
 
 
-def _column_fragments(df: pd.DataFrame, prefixes: List[str]) -> Optional[list]:
-    """Per-column ``{"k": v, ...}`` JSON fragments, in column order,
-    straight off the frame's blocks (no object-dtype conversion)."""
-    fragments: list = [None] * df.shape[1]
+def _float_columns(values: np.ndarray) -> list:
+    """Column lists off a (n_cols, n_rows) float block, non-finite cells
+    replaced by None (simplejson ``ignore_nan`` serializes NaN/Inf as
+    null; the C json encoder would emit invalid bare literals)."""
+    finite = np.isfinite(values)
+    if finite.all():
+        return values.tolist()
+    return [
+        [v if ok else None for v, ok in zip(col, fin)]
+        for col, fin in zip(values.tolist(), finite.tolist())
+    ]
+
+
+def _column_lists(df: pd.DataFrame) -> Optional[list]:
+    """Per-column Python value lists, in column order, straight off the
+    frame's blocks (no object-dtype conversion)."""
+    cols: list = [None] * df.shape[1]
     for block in df._mgr.blocks:
         values = block.values
         if not isinstance(values, np.ndarray):
@@ -200,54 +250,21 @@ def _column_fragments(df: pd.DataFrame, prefixes: List[str]) -> Optional[list]:
         kind = values.dtype.kind
         positions = block.mgr_locs.as_array
         if kind == "f":
-            finite = np.isfinite(values)
-            clean = finite.all(axis=1)
-            rows = values.tolist()
-            for i, pos in enumerate(positions):
-                if clean[i]:
-                    # C-encoder list dump then split: float shortest-repr
-                    # at C speed, identical bytes to dict encoding
-                    parts = _dumps(rows[i])[1:-1].split(", ")
-                else:
-                    parts = [
-                        repr(v) if ok else "null"
-                        for v, ok in zip(rows[i], finite[i])
-                    ]
-                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
-        elif kind in "iu":
-            rows = values.tolist()
-            for i, pos in enumerate(positions):
-                parts = _dumps(rows[i])[1:-1].split(", ")
-                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
-        elif kind == "b":
-            rows = values.tolist()
-            for i, pos in enumerate(positions):
-                parts = ["true" if v else "false" for v in rows[i]]
-                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
+            for pos, col in zip(positions, _float_columns(values)):
+                cols[pos] = col
+        elif kind in "iub":
+            for pos, col in zip(positions, values.tolist()):
+                cols[pos] = col
         elif kind == "O":
             rows = values.tolist()
-            for i, pos in enumerate(positions):
-                parts = []
-                for v in rows[i]:
-                    if v is None:
-                        parts.append("null")
-                    elif type(v) is str:
-                        parts.append(_escape(v))
-                    else:
+            for pos, col in zip(positions, rows):
+                for v in col:
+                    if v is not None and type(v) is not str:
                         return None  # arbitrary objects: pandas path
-                fragments[pos] = "{" + _join(map(_add, prefixes, parts)) + "}"
+                cols[pos] = col
         else:
             return None  # datetime64 / timedelta / anything exotic
-    return fragments
-
-
-def _label(value) -> Optional[str]:
-    kind = type(value)
-    if kind is str:
-        return _escape(value)
-    if kind is int:
-        return '"%d"' % value
-    return None
+    return cols
 
 
 def encode_dataframe(df: pd.DataFrame) -> Optional[str]:
@@ -261,43 +278,199 @@ def encode_dataframe(df: pd.DataFrame) -> Optional[str]:
             # dict(zip(...)) / setdefault deduplicate repeated keys;
             # mirroring that here isn't worth it for a degenerate frame
             return None
-        prefixes = _key_prefixes(index)
-        if prefixes is None:
+        keys = _index_keys(index)
+        if keys is None:
             return None
-        fragments = _column_fragments(df, prefixes)
-        if fragments is None:
+        cols = _column_lists(df)
+        if cols is None:
             return None
-        out = []
+        payload: dict = {}
         if isinstance(df.columns, pd.MultiIndex):
-            current = None
-            subs: list = []
-            closed = set()
-            for (top, sub), fragment in zip(df.columns, fragments):
-                top_l, sub_l = _label(top), _label(sub)
-                if top_l is None or sub_l is None:
+            for (top, sub), col in zip(df.columns, cols):
+                if not _is_key(top) or not _is_key(sub):
                     return None
-                if top != current:
-                    if top in closed:
-                        # non-contiguous top-level group: the dict path
-                        # merges it back into the earlier group — bail
-                        return None
-                    if current is not None:
-                        closed.add(current)
-                        out.append(_label(current) + ": {" + _join(subs) + "}")
-                    current, subs = top, []
-                subs.append(sub_l + ": " + fragment)
-            out.append(_label(current) + ": {" + _join(subs) + "}")
+                payload.setdefault(top, {})[sub] = dict(zip(keys, col))
         else:
-            for name, fragment in zip(df.columns, fragments):
-                name_l = _label(name)
-                if name_l is None:
+            for name, col in zip(df.columns, cols):
+                if not _is_key(name):
                     return None
-                out.append(name_l + ": " + fragment)
-        return "{" + _join(out) + "}"
+                payload[name] = dict(zip(keys, col))
+        return _dumps(payload)
     except Exception:  # noqa: BLE001 — the fallback is always correct;
         # a fast-path crash must degrade to the pandas path, not a 500
         logger.debug("fast-codec encode bailed", exc_info=True)
         return None
+
+
+def encode_raw(raw) -> Optional[str]:
+    """``encode_dataframe`` for an unassembled :class:`models.utils.RawFrame`:
+    the same ``"data"`` fragment, produced without ever building the pandas
+    frame (byte-identical to ``encode_dataframe(raw.to_pandas())`` —
+    asserted by tests/gordo_tpu/test_fast_codec.py). ``None`` falls back to
+    the assembled path.
+
+    For the canonical all-float RangeIndex response the fragment is
+    rendered by the native template encoder (:func:`_encode_raw_native`) —
+    precomputed JSON structure interleaved with CPython-repr-formatted
+    doubles in C — cutting the dominant ``json.dumps`` cost. Everything
+    else takes the pure-Python dict + ``json.dumps`` path below."""
+    try:
+        index = raw.index
+        if not isinstance(index, pd.Index):
+            index = pd.Index(index)
+        if len(index) == 0 or not index.is_unique:
+            return None
+        keys = _index_keys(index)
+        if keys is None:
+            return None
+        if (
+            not _native_poisoned
+            and isinstance(index, pd.RangeIndex)
+            and index.start == 0
+            and index.step == 1
+        ):
+            fragment = _encode_raw_native(raw, index, keys)
+            if fragment is not None:
+                return fragment
+        return _encode_raw_python(raw, index, keys)
+    except Exception:  # noqa: BLE001 — same degrade-don't-500 contract
+        logger.debug("fast-codec raw encode bailed", exc_info=True)
+        return None
+
+
+def _encode_raw_python(raw, index: pd.Index, keys: list) -> Optional[str]:
+    """The dict-building + one-shot ``json.dumps`` raw encode path (also
+    the parity oracle for the native template encoder's self-check)."""
+    start, end = timestamp_columns(index, raw.frequency)
+    # the assembled frame carries ("start", "") / ("end", "") tuples,
+    # so the dict path nests them under an empty sub-key
+    payload: dict = {
+        "start": {"": dict(zip(keys, start))},
+        "end": {"": dict(zip(keys, end))},
+    }
+    for top, subs, values in raw.groups:
+        if not _is_key(top):
+            return None
+        if len(subs) == 0 and values.shape[1] == 0:
+            # a zero-column group contributes no columns to the assembled
+            # frame, so its top-level key never appears in the dict path
+            continue
+        kind = values.dtype.kind
+        if kind == "f":
+            group_cols = _float_columns(values.T)
+        elif kind in "iub":
+            group_cols = values.T.tolist()
+        else:
+            return None
+        if len(group_cols) != len(subs):
+            return None
+        group = payload.setdefault(top, {})
+        for sub, col in zip(subs, group_cols):
+            if not _is_key(sub):
+                return None
+            group[sub] = dict(zip(keys, col))
+    return _dumps(payload)
+
+
+# ------------------------------------------------------- native template path
+#
+# A serving model emits the same response STRUCTURE on every request — same
+# groups, same column names, same row count, RangeIndex — only the float
+# values change. So all the JSON structure (braces, keys, the all-null
+# start/end time columns) is precomputed once per (group-structure, n_rows)
+# as a byte template with a value slot per float, and the native kernel
+# interleaves template chunks with repr-formatted doubles
+# (PyOS_double_to_string — CPython's own formatter, so bytes match
+# json.dumps by construction; NaN/Inf render as null, matching the
+# ignore_nan substitution). Guard rails: the first render of each template
+# is compared byte-for-byte against the pure-Python path, and any mismatch
+# permanently poisons the native encoder for the process.
+
+_native_checked: set = set()
+_native_poisoned = False
+
+
+@functools.lru_cache(maxsize=32)
+def _native_template(sig: tuple, n: int):
+    """(template bytes, per-value chunk lengths) for a RangeIndex(n)
+    response with group structure ``sig = ((top, (sub, ...)), ...)``."""
+    keys = _range_keys(n)
+    null_obj = "{" + ", ".join(f'"{k}": null' for k in keys) + "}"
+    chunks: list = []  # static text; chunks[i] precedes value i
+    cur = [f'{{"start": {{"": {null_obj}}}, "end": {{"": {null_obj}}}']
+    for top, subs in sig:
+        cur.append(f", {_escape(top)}: {{")
+        for j, sub in enumerate(subs):
+            if j:
+                cur.append(", ")
+            cur.append(f"{_escape(sub)}: {{")
+            for i, key in enumerate(keys):
+                if i:
+                    cur.append(", ")
+                cur.append(f"{_escape(key)}: ")
+                chunks.append("".join(cur))
+                cur = []
+            cur.append("}")
+        cur.append("}")
+    cur.append("}")
+    chunks.append("".join(cur))  # trailing chunk after the last value
+    byte_chunks = [c.encode("ascii") for c in chunks]
+    template = b"".join(byte_chunks)
+    pre_lens = np.array([len(c) for c in byte_chunks], dtype=np.int32)
+    return template, pre_lens
+
+
+def _encode_raw_native(raw, index: pd.Index, keys) -> Optional[str]:
+    """Render the fragment via the native template encoder, or ``None``
+    when the structure isn't template-able / the library isn't built."""
+    global _native_poisoned
+    sig_items = []
+    blocks = []
+    for top, subs, values in raw.groups:
+        if type(top) is not str or values.ndim != 2:
+            return None
+        if len(subs) == 0 and values.shape[1] == 0:
+            continue  # dropped by the assembled frame (see Python path)
+        if (
+            values.dtype.kind != "f"
+            or values.shape[1] != len(subs)
+            or values.shape[0] != len(index)
+            or any(type(sub) is not str for sub in subs)
+        ):
+            return None
+        sig_items.append((top, tuple(subs)))
+        blocks.append(values)
+    if not sig_items:
+        return None
+    tops = [item[0] for item in sig_items]
+    if len(set(tops)) != len(tops):
+        return None  # duplicate groups merge in the dict path; template can't
+    sig = tuple(sig_items)
+    template, pre_lens = _native_template(sig, len(index))
+    # column-major per group: group -> column -> rows, matching the
+    # template's key nesting order
+    vals = np.concatenate(
+        [v.T.astype(np.float64, copy=False).ravel() for v in blocks]
+    )
+    rendered = native.encode_template(template, pre_lens, vals)
+    if rendered is None:
+        return None
+    fragment = rendered.decode("ascii")
+    if (sig, len(index)) not in _native_checked:
+        # first render of this template shape: byte-compare against the
+        # Python oracle; a mismatch disables the native encoder for good
+        _native_checked.add((sig, len(index)))
+        expected = _encode_raw_python(raw, index, list(keys))
+        if fragment != expected:
+            _native_poisoned = True
+            logger.error(
+                "native template encoder mismatch for %r (n=%d); "
+                "disabling native encode for this process",
+                tops,
+                len(index),
+            )
+            return None
+    return fragment
 
 
 def splice_response_body(data_fragment: str, rest_json: str) -> str:

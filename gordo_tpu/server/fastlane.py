@@ -41,9 +41,11 @@ import io
 import logging
 import os
 import re
+import selectors
 import socket
 import sys
 import threading
+import time
 import timeit
 from http.client import responses as _status_phrases
 from typing import Dict, Optional, Tuple
@@ -55,6 +57,7 @@ except ImportError:  # pragma: no cover - environment-dependent
     from gordo_tpu.util import _simplejson as simplejson
 
 from gordo_tpu.observability import flight, telemetry, tracing
+from gordo_tpu.observability import metrics as metric_catalog
 from gordo_tpu.server import fast_codec, resilience
 from gordo_tpu.server.server import RequestContext, observe_request_outcome
 
@@ -80,6 +83,26 @@ def enabled() -> bool:
     return os.environ.get("GORDO_TPU_FAST_LANE", "").lower() in (
         "1", "true", "yes",
     )
+
+
+def event_loop_enabled() -> bool:
+    """The ``GORDO_TPU_FAST_LANE_EVENT_LOOP`` gate: when the fast lane is
+    on, connections run on the single-threaded selectors event loop by
+    default; set to 0 to fall back to thread-per-connection."""
+    return os.environ.get(
+        "GORDO_TPU_FAST_LANE_EVENT_LOOP", "1"
+    ).lower() not in ("0", "false", "no")
+
+
+def idle_seconds() -> float:
+    """``GORDO_TPU_FASTLANE_IDLE_S``: how long a keep-alive connection may
+    sit idle *between* requests before the lane closes it (mid-request
+    stalls are governed by the request timeout instead)."""
+    try:
+        value = float(os.environ.get("GORDO_TPU_FASTLANE_IDLE_S", "75"))
+    except ValueError:
+        return 75.0
+    return value if value > 0 else 75.0
 
 
 # --------------------------------------------------------------- request shim
@@ -282,6 +305,7 @@ class FastLaneServer:
                  fd: Optional[int] = None, request_timeout: float = 120.0):
         self.app = app
         self.request_timeout = request_timeout
+        self.idle_timeout = idle_seconds()
         self._shutdown = threading.Event()
         if fd is not None:
             # run_server's prefork path: adopt the shared listening socket
@@ -335,12 +359,22 @@ class FastLaneServer:
         buf = bytearray()
         try:
             while not self._shutdown.is_set():
+                if not buf:
+                    # between requests: the keep-alive idle bound applies,
+                    # not the (longer) request timeout
+                    conn.settimeout(self.idle_timeout)
                 try:
                     head_end = _recv_until(
                         conn, buf, b"\r\n\r\n", MAX_HEAD_BYTES
                     )
                 except _ConnectionClosed:
                     break
+                except socket.timeout:
+                    if not buf:
+                        metric_catalog.FASTLANE_IDLE_CLOSES.inc()
+                    break
+                finally:
+                    conn.settimeout(self.request_timeout)
                 head = bytes(buf[:head_end])
                 del buf[: head_end + 4]
                 method, target, version, headers = _parse_head(head)
@@ -592,8 +626,345 @@ class FastLaneServer:
         return captured["status"], out_headers, b"".join(chunks)
 
 
+# ------------------------------------------------------ event-loop front end
+# incremental parser states, one machine per connection
+_ST_HEAD = 0
+_ST_BODY = 1
+_ST_CHUNK_SIZE = 2
+_ST_CHUNK_DATA = 3
+_ST_CHUNK_CRLF = 4
+_ST_CHUNK_TRAILER = 5
+
+_RECV_CHUNK = 262144
+
+
+class _Conn:
+    """One client connection on the event loop: its socket, input bytes not
+    yet parsed, output bytes not yet written, and the incremental HTTP/1.1
+    parser state carried between readiness callbacks."""
+
+    __slots__ = (
+        "sock", "buf", "out", "state", "method", "target", "version",
+        "headers", "body", "body_remaining", "close_after_flush",
+        "last_activity", "events",
+    )
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.buf = bytearray()
+        self.out = bytearray()
+        self.state = _ST_HEAD
+        self.method = self.target = self.version = ""
+        self.headers: Dict[str, str] = {}
+        self.body = bytearray()
+        self.body_remaining = 0
+        self.close_after_flush = False
+        self.last_activity = time.monotonic()
+        self.events = selectors.EVENT_READ
+
+    def mid_request(self) -> bool:
+        """True while a request is partially received or a response is
+        partially written — the request timeout governs; between requests
+        the idle bound governs instead."""
+        return self.state != _ST_HEAD or bool(self.buf) or bool(self.out)
+
+
+class EventLoopServer(FastLaneServer):
+    """The fast lane on a single-threaded readiness event loop.
+
+    Thread-per-connection spends a thread spawn (or a parked thread) plus
+    scheduler handoffs per connection to wait for bytes that arrive in one
+    or two TCP segments. On the loop, one ``selectors`` poll watches every
+    connection; each gets an incremental HTTP/1.1 parser state machine
+    (head → body / chunked states) fed by whatever bytes are ready, so a
+    request spread across partial reads costs no blocking recv and a
+    pipelined burst of requests is answered from one wakeup. Dispatch is
+    synchronous on the loop thread — handlers already serialize on the
+    device through the batcher, so connection concurrency, not handler
+    concurrency, is what the front end needs.
+
+    Same dispatch stack as the thread lane (``_dispatch`` →
+    ``_handle_hot`` / ``_wsgi_fallback``), so responses are byte-identical
+    by construction; keep-alive, ``Expect: 100-continue``, drain
+    (``Connection: close``), partial writes (buffered, flushed on
+    ``EVENT_WRITE``) and the ``GORDO_TPU_FASTLANE_IDLE_S`` idle bound are
+    handled on the loop."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
+                 fd: Optional[int] = None, request_timeout: float = 120.0):
+        super().__init__(
+            app, host=host, port=port, fd=fd,
+            request_timeout=request_timeout,
+        )
+        self._sock.setblocking(False)
+        self._selector = selectors.DefaultSelector()
+        self._conns: Dict[int, _Conn] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def serve_forever(self):
+        logger.info(
+            "fast lane serving on port %d (event loop; hot routes "
+            "socket-level, everything else via WSGI fallback)",
+            self.server_port,
+        )
+        sel = self._selector
+        sel.register(self._sock, selectors.EVENT_READ, None)
+        last_sweep = time.monotonic()
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    events = sel.select(0.5)
+                except OSError:  # listener closed under us during shutdown
+                    break
+                for key, mask in events:
+                    if key.data is None:
+                        self._accept()
+                        continue
+                    conn = key.data
+                    if mask & selectors.EVENT_WRITE:
+                        self._flush(conn)
+                    if (
+                        mask & selectors.EVENT_READ
+                        and conn.sock.fileno() >= 0
+                    ):
+                        self._on_readable(conn)
+                now = time.monotonic()
+                if now - last_sweep >= 0.5:
+                    last_sweep = now
+                    self._sweep_idle(now)
+        finally:
+            for conn in list(self._conns.values()):
+                self._close(conn)
+            try:
+                sel.unregister(self._sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            sel.close()
+
+    # ----------------------------------------------------------- readiness
+    def _accept(self):
+        while True:
+            try:
+                sock, _addr = self._sock.accept()
+            except (BlockingIOError, socket.timeout, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:  # pragma: no cover - non-TCP sockets in tests
+                pass
+            conn = _Conn(sock)
+            self._conns[sock.fileno()] = conn
+            self._selector.register(sock, selectors.EVENT_READ, conn)
+
+    def _on_readable(self, conn: _Conn):
+        try:
+            while True:
+                chunk = conn.sock.recv(_RECV_CHUNK)
+                if not chunk:
+                    self._close(conn)
+                    return
+                conn.buf.extend(chunk)
+                conn.last_activity = time.monotonic()
+                if len(chunk) < _RECV_CHUNK:
+                    break
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        self._pump(conn)
+
+    def _pump(self, conn: _Conn):
+        """Drive the parser over buffered input; every complete request is
+        dispatched in arrival order (pipelining) and its response appended
+        to the output buffer, flushed once at the end."""
+        try:
+            while self._advance(conn):
+                pass
+        except _BadRequest as exc:
+            conn.out += _serialize(
+                exc.status,
+                [("Content-Type", "application/json")],
+                simplejson.dumps({"error": exc.message}),
+                keep_alive=False,
+            )
+            conn.close_after_flush = True
+            conn.buf.clear()
+            conn.state = _ST_HEAD
+        self._flush(conn)
+
+    def _advance(self, conn: _Conn) -> bool:
+        """One parser step; True when progress was made, False when more
+        bytes are needed (or the connection is already closing)."""
+        if conn.close_after_flush:
+            # a response carrying Connection: close went out (client asked,
+            # or a drain is on): pipelined bytes after it are not served
+            return False
+        buf = conn.buf
+        state = conn.state
+        if state == _ST_HEAD:
+            idx = buf.find(b"\r\n\r\n")
+            if idx < 0:
+                if len(buf) > MAX_HEAD_BYTES:
+                    raise _BadRequest(431, "request head too large")
+                return False
+            head = bytes(buf[:idx])
+            del buf[: idx + 4]
+            (
+                conn.method, conn.target, conn.version, conn.headers,
+            ) = _parse_head(head)
+            if conn.headers.get("expect", "").lower() == "100-continue":
+                conn.out += b"HTTP/1.1 100 Continue\r\n\r\n"
+            conn.body = bytearray()
+            if "chunked" in conn.headers.get(
+                "transfer-encoding", ""
+            ).lower():
+                conn.state = _ST_CHUNK_SIZE
+            else:
+                try:
+                    length = int(
+                        conn.headers.get("content-length", "0") or "0"
+                    )
+                except ValueError:
+                    raise _BadRequest(400, "malformed Content-Length")
+                if length > MAX_BODY_BYTES:
+                    raise _BadRequest(413, "request body too large")
+                conn.body_remaining = length
+                conn.state = _ST_BODY
+            return True
+        if state == _ST_BODY:
+            take = min(len(buf), conn.body_remaining)
+            if take:
+                conn.body += buf[:take]
+                del buf[:take]
+                conn.body_remaining -= take
+            if conn.body_remaining:
+                return False
+            self._finish_request(conn)
+            return True
+        if state == _ST_CHUNK_SIZE:
+            idx = buf.find(b"\r\n")
+            if idx < 0:
+                if len(buf) > MAX_HEAD_BYTES:
+                    raise _BadRequest(400, "malformed chunk size")
+                return False
+            size_line = bytes(buf[:idx]).split(b";", 1)[0].strip()
+            del buf[: idx + 2]
+            try:
+                size = int(size_line, 16)
+            except ValueError:
+                raise _BadRequest(400, "malformed chunk size")
+            if size == 0:
+                conn.state = _ST_CHUNK_TRAILER
+            else:
+                if len(conn.body) + size > MAX_BODY_BYTES:
+                    raise _BadRequest(413, "request body too large")
+                conn.body_remaining = size
+                conn.state = _ST_CHUNK_DATA
+            return True
+        if state == _ST_CHUNK_DATA:
+            take = min(len(buf), conn.body_remaining)
+            if take:
+                conn.body += buf[:take]
+                del buf[:take]
+                conn.body_remaining -= take
+            if conn.body_remaining:
+                return False
+            conn.state = _ST_CHUNK_CRLF
+            return True
+        if state == _ST_CHUNK_CRLF:
+            if len(buf) < 2:
+                return False
+            del buf[:2]
+            conn.state = _ST_CHUNK_SIZE
+            return True
+        # _ST_CHUNK_TRAILER: discard trailer lines up to the blank one
+        idx = buf.find(b"\r\n")
+        if idx < 0:
+            if len(buf) > MAX_HEAD_BYTES:
+                raise _BadRequest(400, "trailer too large")
+            return False
+        if idx == 0:
+            del buf[:2]
+            self._finish_request(conn)
+        else:
+            del buf[: idx + 2]
+        return True
+
+    def _finish_request(self, conn: _Conn):
+        client_keep = self._client_keep_alive(conn.version, conn.headers)
+        keep = client_keep and not resilience.is_draining()
+        conn.out += self._dispatch(
+            conn.method, conn.target, conn.headers, bytes(conn.body), keep
+        )
+        conn.state = _ST_HEAD
+        conn.body = bytearray()
+        conn.last_activity = time.monotonic()
+        if not keep:
+            conn.close_after_flush = True
+
+    # ------------------------------------------------------------- writing
+    def _flush(self, conn: _Conn):
+        if conn.sock.fileno() < 0:
+            return
+        try:
+            while conn.out:
+                sent = conn.sock.send(conn.out)
+                del conn.out[:sent]
+                conn.last_activity = time.monotonic()
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        if conn.out:
+            self._want(conn, selectors.EVENT_READ | selectors.EVENT_WRITE)
+        elif conn.close_after_flush:
+            self._close(conn)
+        else:
+            self._want(conn, selectors.EVENT_READ)
+
+    def _want(self, conn: _Conn, events: int):
+        if conn.events != events:
+            conn.events = events
+            try:
+                self._selector.modify(conn.sock, events, conn)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    # ------------------------------------------------------------- closing
+    def _close(self, conn: _Conn, idle: bool = False):
+        if idle:
+            metric_catalog.FASTLANE_IDLE_CLOSES.inc()
+        fd = conn.sock.fileno()
+        if fd >= 0:
+            self._conns.pop(fd, None)
+        try:
+            self._selector.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:  # pragma: no cover
+            pass
+
+    def _sweep_idle(self, now: float):
+        for conn in list(self._conns.values()):
+            stalled = now - conn.last_activity
+            if conn.mid_request():
+                if stalled > self.request_timeout:
+                    self._close(conn)
+            elif stalled > self.idle_timeout:
+                self._close(conn, idle=True)
+
+
 def make_server(app, host: str, port: int, fd: Optional[int] = None
                 ) -> FastLaneServer:
     """Build the fast-lane front end over an (optionally inherited)
-    listening socket — the ``run_server`` mounting point."""
+    listening socket — the ``run_server`` mounting point. The event loop
+    is the default; ``GORDO_TPU_FAST_LANE_EVENT_LOOP=0`` falls back to
+    thread-per-connection."""
+    if event_loop_enabled():
+        return EventLoopServer(app, host=host, port=port, fd=fd)
     return FastLaneServer(app, host=host, port=port, fd=fd)

@@ -117,6 +117,14 @@ class BadDataFrame(ValueError):
     """Raised when a request payload cannot be coerced to the expected shape."""
 
 
+@lru_cache(maxsize=1024)
+def _expected_index(columns: Tuple[str, ...]) -> pd.Index:
+    """One shared immutable Index per tag list: every request for a model
+    relabels its decoded frame with the same columns, and building the
+    Index from a list costs more than the relabel itself."""
+    return pd.Index(columns)
+
+
 def verify_dataframe(df: pd.DataFrame, expected_columns: List[str]) -> pd.DataFrame:
     """
     Coerce/verify request data against the model's tag columns
@@ -134,7 +142,7 @@ def verify_dataframe(df: pd.DataFrame, expected_columns: List[str]) -> pd.DataFr
                 f"length of {len(expected_columns)}, but got {list(df.columns)} "
                 f"length of {len(df.columns)}"
             )
-        df.columns = expected_columns
+        df.columns = _expected_index(tuple(expected_columns))
         return df
     return df[expected_columns]
 

@@ -133,12 +133,20 @@ def json_response(ctx, payload: dict, status: int = 200) -> Response:
     return json_body(ctx, payload, status).to_werkzeug()
 
 
-def frame_body(ctx, request, df: pd.DataFrame, extra: dict) -> PlainResponse:
+def frame_body(ctx, request, df, extra: dict) -> PlainResponse:
     """Serialize a prediction response frame as ``{"data": ..., **extra,
     "revision": ...}`` — through the numpy-native fast codec when enabled
-    (byte-identical output), else the pandas dict path."""
+    (byte-identical output), else the pandas dict path. ``df`` may be an
+    unassembled :class:`model_utils.RawFrame`, in which case the fast
+    codec encodes straight off the raw blocks and the pandas frame is
+    only assembled when a fallback needs it."""
+    raw = df if isinstance(df, model_utils.RawFrame) else None
     if fast_codec.request_enabled(request):
-        fragment = fast_codec.encode_dataframe(df)
+        fragment = (
+            fast_codec.encode_raw(raw)
+            if raw is not None
+            else fast_codec.encode_dataframe(df)
+        )
         if fragment is not None:
             metric_catalog.FAST_CODEC.labels(op="encode").inc()
             rest = dict(extra)
@@ -151,11 +159,13 @@ def frame_body(ctx, request, df: pd.DataFrame, extra: dict) -> PlainResponse:
             )
             return PlainResponse(body, status=200)
         metric_catalog.FAST_CODEC_FALLBACK.labels(op="encode").inc()
+    if raw is not None:
+        df = raw.to_pandas()
     payload = {"data": server_utils.dataframe_to_dict(df), **extra}
     return json_body(ctx, payload, 200)
 
 
-def frame_response(ctx, request, df: pd.DataFrame, extra: dict) -> Response:
+def frame_response(ctx, request, df, extra: dict) -> Response:
     return frame_body(ctx, request, df, extra).to_werkzeug()
 
 
@@ -235,21 +245,37 @@ def extract_X_y(request, mc: ModelContext):
     columns against the model's tags (reference server/utils.py:249-320).
     Returns (X, y) or raises BadDataFrame/ValueError.
     """
-    payload = request.get_json(silent=True) if request.is_json else None
-    if (payload is None or "X" not in payload) and "X" not in request.files:
-        raise server_utils.BadDataFrame('Cannot predict without "X"')
+    X = y = None
+    # fast lane: one native pass over the raw body straight into float64
+    # frames, skipping json.loads entirely; any non-canonical body falls
+    # through to the ordinary parse below with identical results
+    body = getattr(request, "_body", None)
+    if body is not None and request.is_json and fast_codec.request_enabled(request):
+        parsed = fast_codec.decode_body_xy(body)
+        if parsed is not None:
+            X, y = parsed
+            metric_catalog.FAST_CODEC.labels(op="decode").inc()
+            if y is not None:
+                metric_catalog.FAST_CODEC.labels(op="decode").inc()
 
-    if payload is not None:
-        fast = fast_codec.request_enabled(request)
-        X = _decode_frame(payload["X"], fast)
-        y = payload.get("y")
-        if y is not None:
-            y = _decode_frame(y, fast)
-    else:
-        X = server_utils.dataframe_from_parquet_bytes(request.files["X"].read())
-        y = request.files.get("y")
-        if y is not None:
-            y = server_utils.dataframe_from_parquet_bytes(y.read())
+    if X is None:
+        payload = request.get_json(silent=True) if request.is_json else None
+        if (payload is None or "X" not in payload) and "X" not in request.files:
+            raise server_utils.BadDataFrame('Cannot predict without "X"')
+
+        if payload is not None:
+            fast = fast_codec.request_enabled(request)
+            X = _decode_frame(payload["X"], fast)
+            y = payload.get("y")
+            if y is not None:
+                y = _decode_frame(y, fast)
+        else:
+            X = server_utils.dataframe_from_parquet_bytes(
+                request.files["X"].read()
+            )
+            y = request.files.get("y")
+            if y is not None:
+                y = server_utils.dataframe_from_parquet_bytes(y.read())
 
     X = server_utils.verify_dataframe(X, [t.name for t in mc.tags])
     if y is not None:
@@ -341,7 +367,7 @@ def base_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
     resilience.record_breaker_success(breaker)
 
     with ctx.phase("encode"):
-        data = model_utils.make_base_dataframe(
+        data = model_utils.make_base_raw(
             tags=mc.tags,
             model_input=X.values if isinstance(X, pd.DataFrame) else X,
             model_output=output,
@@ -353,7 +379,7 @@ def base_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
         )
         if request.args.get("format") == "parquet":
             return PlainResponse(
-                server_utils.dataframe_into_parquet_bytes(data),
+                server_utils.dataframe_into_parquet_bytes(data.to_pandas()),
                 mimetype="application/octet-stream",
             )
         # serialization happens INSIDE the encode phase so Server-Timing's
@@ -402,7 +428,11 @@ def anomaly_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
         with ctx.phase("predict"):
             faults.fault_point("serve_predict", machine=gordo_name)
             resilience.check_deadline("preflight")
-            anomaly_df = mc.model.anomaly(X, y, frequency=mc.frequency)
+            # models exposing anomaly_raw return the unassembled RawFrame
+            # (anomaly() is exactly anomaly_raw().to_pandas()); the fast
+            # codec then encodes without ever building the pandas frame
+            anomaly_fn = getattr(mc.model, "anomaly_raw", mc.model.anomaly)
+            anomaly_df = anomaly_fn(X, y, frequency=mc.frequency)
     except resilience.DeadlineExceeded as exc:
         logger.warning("Deadline exceeded predicting %r: %s", gordo_name, exc)
         return json_body(ctx, {"error": str(exc)}, 504)
@@ -429,18 +459,26 @@ def anomaly_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
     resilience.record_breaker_success(breaker)
 
     with ctx.phase("encode"):
+        is_raw = isinstance(anomaly_df, model_utils.RawFrame)
         if request.args.get("all_columns") is None:
-            drop = [
-                c
-                for c in anomaly_df.columns.get_level_values(0).unique()
-                if c in DELETED_FROM_RESPONSE_COLUMNS
-            ]
+            tops = (
+                anomaly_df.top_levels()
+                if is_raw
+                else anomaly_df.columns.get_level_values(0).unique()
+            )
+            drop = [c for c in tops if c in DELETED_FROM_RESPONSE_COLUMNS]
             if drop:  # drop() copies the frame even for an empty list
-                anomaly_df = anomaly_df.drop(columns=drop, level=0)
+                anomaly_df = (
+                    anomaly_df.drop_top_level(drop)
+                    if is_raw
+                    else anomaly_df.drop(columns=drop, level=0)
+                )
 
         if request.args.get("format") == "parquet":
             return PlainResponse(
-                server_utils.dataframe_into_parquet_bytes(anomaly_df),
+                server_utils.dataframe_into_parquet_bytes(
+                    anomaly_df.to_pandas() if is_raw else anomaly_df
+                ),
                 mimetype="application/octet-stream",
             )
         context = {

@@ -61,6 +61,35 @@ def _jax_estimators(model):
             stack.extend(step for _name, step in node.steps)
 
 
+def _prelower_programs(model, bucket_rows, offset, n_features) -> int:
+    """AOT pre-lower + compile the batcher's stacked serving programs for
+    every (row bucket, fuse-width bucket) this artifact's spec can hit
+    (CrossModelBatcher.prelower). Warmup's own predicts only compile the
+    width the sequential warmup traffic produces; the wider fuse buckets
+    would otherwise pay their trace+compile inside the first real burst.
+    Returns how many programs were compiled."""
+    from gordo_tpu.ops.train import pad_for_predict
+    from gordo_tpu.server.batcher import get_batcher
+
+    batcher = get_batcher()
+    if batcher is None:
+        return 0
+    compiled = 0
+    for estimator in _jax_estimators(model):
+        for bucket in bucket_rows:
+            try:
+                X = np.zeros(
+                    (int(bucket) + int(offset), n_features), np.float32
+                )
+                X_pad, n_pad, _ = pad_for_predict(estimator.spec_, X)
+                compiled += batcher.prelower(estimator.spec_, X_pad, n_pad)
+            except Exception as exc:  # noqa: BLE001 — warmup is best-effort
+                logger.warning(
+                    "AOT pre-lowering failed for bucket %s: %s", bucket, exc
+                )
+    return compiled
+
+
 def _register_params(model) -> int:
     """Commit-once pre-registration: push the artifact's params into the
     cross-model batcher's device-resident bank (when batching is enabled)
@@ -134,10 +163,20 @@ def warmup_collection(
     from gordo_tpu.server.utils import load_metadata, load_model
 
     t0 = time.monotonic()
+    # kick the native codec build in the background: it races the (much
+    # slower) XLA compiles below, so the first request finds the parser/
+    # encoder .so ready without warmup ever blocking on gcc
+    try:
+        from gordo_tpu import native
+
+        native.prebuild(block=False)
+    except Exception:  # noqa: BLE001 — warmup is best-effort
+        pass
     if bucket_rows is None:
         bucket_rows = _default_bucket_rows()
     names = list(names) if names is not None else _model_names(collection_dir)
     programs = 0
+    aot_programs = 0
     warmed = 0
     registered = 0
     failed = []
@@ -173,20 +212,28 @@ def warmup_collection(
             # — including specs the auto-A/B stood down and re-enables
             # later. Lazy registration would pay the stack in-request.
             registered += _register_params(model)
+            # AOT (ISSUE 11): with params resident the bank's stacked
+            # shapes are final — pre-lower the fused programs for every
+            # fuse-width bucket so no steady-state request ever traces
+            aot_programs += _prelower_programs(
+                model, bucket_rows, offset, n_features
+            )
             warmed += 1
         except Exception as exc:  # noqa: BLE001 — warmup is best-effort
             logger.warning("warmup failed for model %r: %s", name, exc)
             failed.append(name)
     seconds = time.monotonic() - t0
     logger.info(
-        "serving warmup: %d model(s), %d predict program(s), %d param-bank "
-        "registration(s) in %.1fs%s",
-        warmed, programs, registered, seconds,
+        "serving warmup: %d model(s), %d predict program(s), %d AOT "
+        "pre-lowered fused program(s), %d param-bank registration(s) "
+        "in %.1fs%s",
+        warmed, programs, aot_programs, registered, seconds,
         f" ({len(failed)} failed: {failed})" if failed else "",
     )
     return {
         "models": warmed,
         "programs": programs,
+        "aot_programs": aot_programs,
         "registered_params": registered,
         "seconds": round(seconds, 2),
         "failed": failed,

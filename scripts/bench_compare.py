@@ -59,6 +59,12 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # records, so it only gates once both sides of a pair carry it
     ("server_load_fastlane_req_per_sec", True),
     ("server_load_fastlane_p99_ms", False),
+    # sub-millisecond hot path, phase 2 (ISSUE 11): the event-loop fast
+    # lane's headline is its median and extreme tail under the open-loop
+    # schedule — both gate so an event-loop regression can't hide behind
+    # an unchanged p99
+    ("server_load_fastlane_p50_ms", False),
+    ("server_load_fastlane_p999_ms", False),
     # fleet-plane merged view of the same load (ISSUE 9): the merged p99
     # gates like the harness-side p99; the burn rates are ratios where
     # lower is better (burn 1.0 = consuming budget exactly as allowed)

@@ -43,9 +43,13 @@ _NUMERIC_KEYS = (
     "server_d2h_floor_ms", "server_p50_net_of_floor_ms",
     "server_load_req_per_sec", "server_load_p50_ms",
     "server_load_p99_ms", "server_load_p999_ms",
-    # the socket fast lane's arm of the serving_load section (ISSUE 7)
+    # the socket fast lane's arm of the serving_load section (ISSUE 7);
+    # p99.9 and the steady-state trace-compile count joined in ISSUE 11
+    # (event-loop lane — trace_compiles must read 0 once warmup AOT
+    # pre-lowering is doing its job)
     "server_load_fastlane_req_per_sec", "server_load_fastlane_p50_ms",
-    "server_load_fastlane_p99_ms",
+    "server_load_fastlane_p99_ms", "server_load_fastlane_p999_ms",
+    "server_load_trace_compiles_steady",
     # the fleet observability plane's merged view of the load (ISSUE 9);
     # peak_source rides alongside but is a string tag, not a number
     "server_fleet_workers", "server_fleet_requests_total",

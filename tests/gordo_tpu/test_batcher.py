@@ -390,6 +390,65 @@ def test_timeout_abandons_item_and_skips_it_at_fanout(
     assert b.device_call_stuck_s() == 0.0
 
 
+def test_ring_submit_eight_concurrent_producers(models):
+    """ISSUE 11: the wait-free submit ring under 8 concurrent producers,
+    several rounds each — every result matches the direct path (nothing
+    lost, nothing cross-wired between waiters), and the mid-run idle gap
+    forces the dispatcher through its park/eventfd-wake path."""
+    b = CrossModelBatcher(window_ms=0, max_batch=64)
+    rng = np.random.RandomState(11)
+    X = rng.rand(25, 4).astype(np.float32)
+    direct = [m.predict(X) for m in models]
+    rounds = 6
+    results = [[None] * rounds for _ in range(8)]
+
+    def producer(t):
+        for r in range(rounds):
+            results[t][r] = b.submit(
+                models[t % 3].spec_, models[t % 3].params_, X
+            )
+            if r == rounds // 2:
+                time.sleep(0.05)  # drain + park before the next burst
+
+    threads = [
+        threading.Thread(target=producer, args=(t,)) for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for t in range(8):
+        for r in range(rounds):
+            np.testing.assert_allclose(
+                results[t][r], direct[t % 3], rtol=1e-6, atol=1e-7
+            )
+    assert b.stats["items"] == 8 * rounds
+
+
+def test_abandon_then_resubmit_same_thread(models, monkeypatch, _fresh_plan):
+    """Deadline-abandon then an immediate resubmit from the SAME thread:
+    abandoning discards the thread's pooled completion waiter, so the
+    dispatcher's late set() on the abandoned item (it was already inside
+    the wedged device call) lands on an orphan Event and can never
+    complete the thread's next item early with a missing result."""
+    _set_plan(
+        monkeypatch,
+        [{"site": "serve_device_call", "times": 1, "error": "wedge",
+          "seconds": 0.6}],
+    )
+    b = CrossModelBatcher(window_ms=0, max_batch=8, timeout_s=0.15)
+    X = np.random.RandomState(13).rand(10, 4).astype(np.float32)
+    with pytest.raises(TimeoutError):
+        b.submit(models[0].spec_, models[0].params_, X)
+    # the wedged call is still running; give the resubmit room to queue
+    # behind it and outlive the late fan-out of the abandoned item
+    b.timeout_s = 10.0
+    out = b.submit(models[1].spec_, models[1].params_, X)
+    np.testing.assert_allclose(
+        out, models[1].predict(X), rtol=1e-6, atol=1e-7
+    )
+
+
 def test_deadline_in_scope_bounds_queue_wait(models, monkeypatch, _fresh_plan):
     """A request deadline (resilience scope) beats the batcher's own
     timeout and surfaces as DeadlineExceeded."""
@@ -588,3 +647,39 @@ def test_warmup_preregisters_params_no_restack_at_first_traffic(
     assert (
         metric_catalog.PARAM_BANK_RESTACKS.value() == restacks_after_warmup
     ), "post-warmup traffic restacked a param bank"
+
+
+def test_warmup_aot_prelowers_zero_steady_state_trace_compiles(
+    model_collection_directory, trained_model_directories, monkeypatch
+):
+    """Tentpole layer 3: warmup AOT pre-lowers the fused serving programs
+    (``CrossModelBatcher.prelower``), so the first fused call of real
+    traffic executes an already-compiled program —
+    ``gordo_server_trace_compiles_total`` stays flat from the end of
+    warmup onward."""
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.server import warmup
+    from gordo_tpu.server.utils import load_model
+
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+
+    result = warmup.warmup_collection(model_collection_directory)
+    assert result["failed"] == []
+    assert result["aot_programs"] > 0
+
+    b = batcher_mod.peek_batcher()
+    assert b is not None
+    assert b._aot, "warmup left no AOT executables behind"
+
+    compiles_after_warmup = metric_catalog.TRACE_COMPILES.value()
+    # steady state: bucket-shaped traffic (100 rows pads to the 128-row
+    # warmup bucket) through every warmed artifact must not trace
+    rng = np.random.RandomState(6)
+    for name in trained_model_directories:
+        model = load_model(model_collection_directory, name)
+        X = rng.rand(100, 4)
+        model.predict(X)
+    assert (
+        metric_catalog.TRACE_COMPILES.value() == compiles_after_warmup
+    ), "post-warmup traffic paid a trace+compile in the serving path"

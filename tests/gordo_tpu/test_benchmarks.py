@@ -427,6 +427,68 @@ def test_load_test_open_loop_surfaces_stall_in_tail():
     assert report["p50_ms"] < 100, report
 
 
+def test_load_test_multiprocess_open_loop_covers_schedule_exactly():
+    """``--processes N`` forks the generator: children stride-slice one
+    global schedule (child k owns i ≡ k mod N), so the union covers every
+    arrival index exactly once — measured request count must equal the
+    single-process schedule's, with zero errors."""
+    import time as _time
+
+    from benchmarks.load_test import run_open_processes, summarize
+
+    def send():
+        _time.sleep(0.002)
+        return None, None, {"request_walltime": 0.002}
+
+    qps, duration, warmup = 50, 1.0, 0.2
+    stats, wall = run_open_processes(
+        send, users=2, qps=qps, duration=duration, warmup=warmup,
+        processes=2,
+    )
+    report = summarize(stats, wall, 1)
+    total = int(round((warmup + duration) * qps))
+    first_measured = int(round(warmup * qps))
+    assert report["requests"] == total - first_measured
+    assert report["errors"] == 0
+    assert report["p50_ms"] and report["p50_ms"] >= 2.0
+    # phase histograms survive the pipe and merge
+    assert report["phases"]["request_walltime"]["p50_ms"] == pytest.approx(
+        2.0, rel=0.02
+    )
+
+
+def test_load_test_histograms_merge_exactly_across_processes():
+    """The merge the parent performs on child histograms is exact: bucket
+    counts add, so quantiles of the merged histogram equal quantiles of
+    one histogram fed every sample — serialization round trip included."""
+    import json as _json
+
+    import numpy as np
+
+    from benchmarks.load_test import (
+        WorkerStats, _stats_from_dict, _stats_to_dict,
+    )
+    from gordo_tpu.observability.latency import LatencyHistogram
+
+    rng = np.random.RandomState(7)
+    samples = rng.gamma(2.0, 0.004, size=4000)
+    reference = LatencyHistogram()
+    shards = [WorkerStats(), WorkerStats(), WorkerStats()]
+    for i, value in enumerate(samples):
+        reference.record(float(value))
+        shards[i % 3].observe(float(value), None, None, {}, measured=True)
+
+    # round trip through the pipe wire format, then merge
+    wired = [
+        _stats_from_dict(_json.loads(_json.dumps(_stats_to_dict(s))))
+        for s in shards
+    ]
+    merged = LatencyHistogram.merged(w.hist for w in wired)
+    assert merged.count == reference.count == len(samples)
+    for q in (0.5, 0.9, 0.99, 0.999):
+        assert merged.quantile(q) == reference.quantile(q), q
+
+
 def test_load_test_flight_cross_check(live_server, gordo_project,
                                       monkeypatch, capsys):
     """The closing argument: the report's worst requests come back with

@@ -135,12 +135,59 @@ def test_encode_fallback_shapes():
     assert fast_codec.encode_dataframe(datetime_col) is None
     objects = pd.DataFrame({"o": [object(), object()]})
     assert fast_codec.encode_dataframe(objects) is None
-    # non-contiguous top-level groups merge in the dict path — fast bails
+    # non-contiguous top-level groups merge in the dict path — the fast
+    # encoder builds the nested dict with the same setdefault idiom, so
+    # it merges identically instead of bailing
     scattered = pd.DataFrame(
         np.random.rand(3, 3),
         columns=pd.MultiIndex.from_tuples([("a", "x"), ("b", "x"), ("a", "y")]),
     )
-    assert fast_codec.encode_dataframe(scattered) is None
+    _assert_encode_parity(scattered)
+
+
+def _raw_frame(index, with_nan=False):
+    from gordo_tpu.models import utils as model_utils
+
+    n = len(index)
+    rng = np.random.RandomState(5)
+    out = rng.rand(n, 2).astype(np.float32)  # model output is float32
+    if with_nan:
+        out[0, 0] = np.nan
+        out[-1, -1] = np.inf
+    groups = [
+        ("model-input", ["a", "b"], rng.rand(n, 2)),
+        ("model-output", ["a", "b"], out),
+        ("smooth-total-anomaly-scaled", ("",), rng.rand(n, 1)),
+        ("total-anomaly-scaled", ("",), rng.rand(n, 1)),
+    ]
+    return model_utils.RawFrame(groups, index, pd.Timedelta("10min"))
+
+
+@pytest.mark.parametrize("with_nan", [False, True])
+def test_encode_raw_matches_assembled(with_nan):
+    """encode_raw off the unassembled blocks == encode_dataframe of the
+    assembled frame == the pandas dict path, byte for byte."""
+    for index in (
+        pd.RangeIndex(10),
+        pd.date_range("2020-01-01", periods=10, freq="10min", tz="UTC"),
+    ):
+        raw = _raw_frame(index, with_nan=with_nan)
+        fragment = fast_codec.encode_raw(raw)
+        assert fragment is not None
+        assert fragment == fast_codec.encode_dataframe(raw.to_pandas())
+        assert fragment == _slow_json(raw.to_pandas())
+
+
+def test_encode_raw_drop_top_level_matches_pandas_drop():
+    raw = _raw_frame(pd.RangeIndex(6))
+    dropped = raw.drop_top_level(["smooth-total-anomaly-scaled"])
+    df = raw.to_pandas().drop(columns=["smooth-total-anomaly-scaled"], level=0)
+    assert fast_codec.encode_raw(dropped) == fast_codec.encode_dataframe(df)
+    assert dropped.top_levels() == [
+        "model-input",
+        "model-output",
+        "total-anomaly-scaled",
+    ]
 
 
 def test_splice_response_body():
@@ -160,8 +207,8 @@ def _assert_decode_parity(payload):
     assert list(fast.index) == list(slow.index)
     assert [str(c) for c in fast.columns] == [str(c) for c in slow.columns]
     # the serialized keys — what the client sees — must agree exactly
-    assert fast_codec._key_prefixes(fast.index) == fast_codec._key_prefixes(
-        slow.index
+    assert list(map(str, fast_codec._index_keys(fast.index))) == list(
+        map(str, fast_codec._index_keys(slow.index))
     )
 
 

@@ -12,7 +12,9 @@ normalize before comparing bytes.
 import http.client
 import json
 import re
+import socket
 import threading
+import time
 
 import numpy as np
 import pandas as pd
@@ -35,9 +37,17 @@ def wsgi_client(app):
     return app.test_client()
 
 
-@pytest.fixture(scope="module")
-def fast_server(app):
-    server = fastlane.FastLaneServer(app, host="127.0.0.1", port=0)
+# every test in this module runs twice: once against the thread-per-
+# connection lane, once against the selectors event loop (ISSUE 11) —
+# the byte-parity contract binds both front ends
+@pytest.fixture(scope="module", params=["threads", "event_loop"])
+def fast_server(app, request):
+    cls = (
+        fastlane.EventLoopServer
+        if request.param == "event_loop"
+        else fastlane.FastLaneServer
+    )
+    server = cls(app, host="127.0.0.1", port=0)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     yield server
@@ -556,6 +566,127 @@ def test_fast_lane_with_batcher(
         t.join()
     assert batcher_mod._batcher is not None
     assert batcher_mod._batcher.stats["items"] >= 5
+
+
+# ----------------------------------------- wire-level connection handling
+def _raw_request(project, name, body: bytes) -> bytes:
+    return (
+        f"POST /gordo/v0/{project}/{name}/prediction HTTP/1.1\r\n"
+        "Host: localhost\r\n"
+        "Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+
+
+def _read_one_response(reader):
+    """(status, body) for one framed response off a socket file."""
+    status_line = reader.readline()
+    assert status_line.startswith(b"HTTP/1.1 "), status_line
+    status = int(status_line.split(b" ", 2)[1])
+    length = 0
+    while True:
+        line = reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1])
+    return status, reader.read(length)
+
+
+def test_pipelined_requests_one_burst(
+    fast_server, gordo_project, gordo_name, X_payload
+):
+    """Three requests written back-to-back in one send: all three answered
+    in order on the same connection (the parser must carry residual bytes
+    across dispatches, not drop them)."""
+    body = json.dumps({"X": X_payload.values.tolist()}).encode()
+    req = _raw_request(gordo_project, gordo_name, body)
+    sock = socket.create_connection(
+        ("127.0.0.1", fast_server.server_port), timeout=60
+    )
+    try:
+        sock.sendall(req * 3)
+        reader = sock.makefile("rb")
+        for _ in range(3):
+            status, out = _read_one_response(reader)
+            assert status == 200
+            assert b"model-output" in out
+    finally:
+        sock.close()
+
+
+def test_partial_reads_trickled_bytes(
+    fast_server, gordo_project, gordo_name, X_payload
+):
+    """A request trickled in small fragments (head split mid-line, body
+    split mid-token) still parses and serves — the incremental state
+    machine never depends on message boundaries lining up with reads."""
+    body = json.dumps({"X": X_payload.values.tolist()}).encode()
+    req = _raw_request(gordo_project, gordo_name, body)
+    step = max(1, len(req) // 7)
+    sock = socket.create_connection(
+        ("127.0.0.1", fast_server.server_port), timeout=60
+    )
+    try:
+        for offset in range(0, len(req), step):
+            sock.sendall(req[offset:offset + step])
+            time.sleep(0.01)
+        status, out = _read_one_response(sock.makefile("rb"))
+        assert status == 200
+        assert b"model-output" in out
+    finally:
+        sock.close()
+
+
+def test_close_mid_header_is_harmless(fast_server):
+    """A peer vanishing mid-request-head must not wedge or kill the
+    server; the next connection serves normally."""
+    sock = socket.create_connection(
+        ("127.0.0.1", fast_server.server_port), timeout=10
+    )
+    sock.sendall(b"POST /gordo/v0/p/m/prediction HTTP/1.1\r\nConte")
+    sock.close()
+    time.sleep(0.1)
+    status, headers, _ = _fast_request(fast_server, "GET", "/healthcheck")
+    assert status == 200
+
+
+@pytest.mark.parametrize("lane", ["threads", "event_loop"])
+def test_idle_keep_alive_bounded_and_counted(app, monkeypatch, lane):
+    """GORDO_TPU_FASTLANE_IDLE_S: a keep-alive connection idle between
+    requests is closed by the server (EOF at the client) and counted in
+    gordo_server_fastlane_idle_closes_total — on both lanes."""
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    monkeypatch.setenv("GORDO_TPU_FASTLANE_IDLE_S", "0.6")
+    cls = (
+        fastlane.EventLoopServer if lane == "event_loop"
+        else fastlane.FastLaneServer
+    )
+    server = cls(app, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    before = metric_catalog.FASTLANE_IDLE_CLOSES.value()
+    try:
+        sock = socket.create_connection(
+            ("127.0.0.1", server.server_port), timeout=30
+        )
+        try:
+            sock.sendall(
+                b"GET /healthcheck HTTP/1.1\r\nHost: localhost\r\n\r\n"
+            )
+            reader = sock.makefile("rb")
+            status, _ = _read_one_response(reader)
+            assert status == 200
+            # now idle: the server must close within the bound (+sweep tick)
+            sock.settimeout(5)
+            assert sock.recv(1) == b""
+        finally:
+            sock.close()
+        assert metric_catalog.FASTLANE_IDLE_CLOSES.value() == before + 1
+    finally:
+        server.server_close()
+        thread.join(timeout=5)
 
 
 # ------------------------------------------------- observability parity

@@ -401,7 +401,8 @@ def test_warmup_windowed_model_uses_offset(tmp_path):
 
     result = warmup.warmup_collection(str(tmp_path), bucket_rows=(8,))
     assert result == {
-        "models": 1, "programs": 1, "registered_params": 0,
+        "models": 1, "programs": 1, "aot_programs": 0,
+        "registered_params": 0,
         "seconds": result["seconds"], "failed": [],
     }
     # the warmed bucket serves a real 8-output-row request without error
