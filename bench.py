@@ -617,9 +617,132 @@ def _bench_serving_load() -> dict:
             fl_server.server_close()
     except Exception as exc:  # noqa: BLE001 — keep the WSGI arm's record
         out["fastlane_qps"] = {"error": repr(exc)[:300]}
+
+    # the serving_gateway arm (ISSUE 12): the SAME collection behind two
+    # lease-registered fast-lane nodes and one consistent-hash gateway —
+    # routed-vs-direct overhead plus the kill-a-node recovery time.
+    # Failure here must not cost the section the arms already measured.
+    try:
+        out["gateway"] = _bench_serving_gateway(
+            collection, machine_out.name, load_test,
+            qps=qps, duration=max(2.0, duration / 2),
+            warmup=min(warmup, 0.5), users=users,
+            direct_p50_ms=(out.get("fastlane_qps") or {}).get("p50_ms"),
+        )
+    except Exception as exc:  # noqa: BLE001 — keep the direct arms' record
+        out["gateway"] = {"error": repr(exc)[:300]}
     out["fleet"] = _serving_fleet_summary(machine_out.name)
     emit_partial(out)
     return out
+
+
+def _bench_serving_gateway(collection, machine, load_test, qps, duration,
+                           warmup, users, direct_p50_ms):
+    """Two fast-lane nodes with filesystem leases, one gateway in front;
+    the open-loop schedule routed through it, then the machine's ring
+    primary is killed (listener down, heartbeat stopped without unlink —
+    a crash, not a leave) and the arm measures how long until the
+    gateway answers 200 for that machine again (hedge + breaker + lease
+    staleness, whichever lands first)."""
+    import http.client
+    import tempfile
+    import threading
+
+    from gordo_tpu.server import fastlane, membership
+    from gordo_tpu.server import gateway as gateway_mod
+    from gordo_tpu.server.server import build_app
+
+    # bench-scale failure detection: production defaults (60 s lease)
+    # would dominate a 120 s section leash. Saved/restored so later
+    # sections see the operator's environment.
+    knobs = {
+        membership.LEASE_TIMEOUT_ENV: "2.0",
+        membership.HEARTBEAT_ENV: "0.1",
+        "GORDO_TPU_GATEWAY_HEALTH_S": "0.2",
+        "GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S": "0.5",
+    }
+    saved = {key: os.environ.get(key) for key in knobs}
+    os.environ.update(knobs)
+    directory = tempfile.mkdtemp(prefix="bench-gateway-")
+    nodes = []
+    gateway = None
+    try:
+        for i in range(2):
+            node = fastlane.make_server(
+                build_app({"MODEL_COLLECTION_DIR": collection}),
+                host="127.0.0.1", port=0,
+            )
+            threading.Thread(target=node.serve_forever, daemon=True).start()
+            registration = membership.NodeRegistration(
+                directory, f"127.0.0.1:{node.server_port}",
+                node_id=f"bench-node-{i}",
+            )
+            nodes.append((node, registration))
+        gateway = gateway_mod.GatewayServer(directory)
+        threading.Thread(target=gateway.serve_forever, daemon=True).start()
+        deadline = time.time() + 5.0
+        while len(gateway.ring.nodes) < len(nodes) and time.time() < deadline:
+            time.sleep(0.05)
+
+        result = load_test.run(
+            host=f"http://127.0.0.1:{gateway.server_port}",
+            project="bench", machine=machine,
+            mode="qps", qps=qps, users=users, duration=duration,
+            warmup=warmup, samples=100, flight=False,
+        )
+        result["nodes"] = len(nodes)
+        if direct_p50_ms is not None and result.get("p50_ms") is not None:
+            result["p50_overhead_ms"] = round(
+                result["p50_ms"] - direct_p50_ms, 3
+            )
+
+        primary = gateway.ring.candidates(machine, limit=1)[0]
+        victim, victim_reg = next(
+            (node, reg) for node, reg in nodes if reg.node_id == primary
+        )
+        victim_reg._stop.set()  # crash: heartbeat stops, lease left to rot
+        t_kill = time.monotonic()
+        victim.server_close()
+        recovery_s = None
+        probe_deadline = time.monotonic() + 10.0
+        while time.monotonic() < probe_deadline:
+            try:
+                probe = http.client.HTTPConnection(
+                    "127.0.0.1", gateway.server_port, timeout=2.0
+                )
+                try:
+                    probe.request(
+                        "GET", f"/gordo/v0/bench/{machine}/metadata"
+                    )
+                    response = probe.getresponse()
+                    response.read()
+                    if response.status == 200:
+                        recovery_s = round(time.monotonic() - t_kill, 3)
+                        break
+                finally:
+                    probe.close()
+            except OSError:
+                pass
+            time.sleep(0.05)
+        result["recovery_s"] = recovery_s
+        return result
+    finally:
+        if gateway is not None:
+            gateway.server_close()
+        for node, registration in nodes:
+            try:
+                registration.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            try:
+                node.server_close()
+            except Exception:  # noqa: BLE001 — victim is already closed
+                pass
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
 
 
 def _serving_fleet_summary(model: str) -> dict:
@@ -1907,6 +2030,7 @@ def _emit_record(sections: dict, recovered: list):
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
     load_fastlane = load_res.get("fastlane_qps") or {}
+    load_gateway = load_res.get("gateway") or {}
     load_fleet = load_res.get("fleet") or {}
     load_flight = load_qps.get("flight") or {}
     out = {
@@ -1949,6 +2073,18 @@ def _emit_record(sections: dict, recovered: list):
         "server_load_trace_compiles_steady": load_fastlane.get(
             "trace_compiles_steady"
         ),
+        # the cross-node gateway arm of the same open-loop schedule
+        # (ISSUE 12): routed percentiles, the overhead over the direct
+        # fast-lane arm, and the kill-a-node recovery time (absent in
+        # pre-gateway records, so bench_compare only gates once both
+        # sides of a pair carry them)
+        "server_gateway_req_per_sec": load_gateway.get("req_per_sec"),
+        "server_gateway_p50_ms": load_gateway.get("p50_ms"),
+        "server_gateway_p99_ms": load_gateway.get("p99_ms"),
+        "server_gateway_p50_overhead_ms": load_gateway.get(
+            "p50_overhead_ms"
+        ),
+        "server_gateway_recovery_s": load_gateway.get("recovery_s"),
         # the fleet observability plane's merged view of the same load
         # (ISSUE 9): telemetry-shard merge + per-model SLO windows
         "server_fleet_workers": load_fleet.get("workers"),
@@ -1964,6 +2100,8 @@ def _emit_record(sections: dict, recovered: list):
             "errors": load_qps.get("errors"),
             "fastlane_errors": load_fastlane.get("errors"),
             "fastlane_event_loop": load_fastlane.get("event_loop"),
+            "gateway_errors": load_gateway.get("errors"),
+            "gateway_nodes": load_gateway.get("nodes"),
             "worst_traces": [
                 w.get("trace_id")
                 for w in (load_flight.get("worst_requests") or [])[:3]

@@ -591,9 +591,39 @@ def run_server_cli(host, port, workers, worker_connections, batch_predicts, warm
     )
 
 
+@click.command("run-gateway")
+@click.option(
+    "--host", type=HostIP(), default="0.0.0.0", envvar="GORDO_GATEWAY_HOST"
+)
+@click.option(
+    "--port", type=click.IntRange(1, 65535), default=5556,
+    envvar="GORDO_GATEWAY_PORT",
+)
+@click.option(
+    "--membership-dir",
+    type=click.Path(file_okay=False),
+    default=None,
+    envvar="GORDO_TPU_GATEWAY_DIR",
+    help="Shared membership directory the serving nodes heartbeat their "
+    "leases into (filesystem membership — no etcd/consul). Defaults to "
+    "GORDO_TPU_GATEWAY_DIR.",
+)
+def run_gateway_cli(host, port, membership_dir):
+    """Run the fault-tolerant cross-node serving gateway.
+
+    Consistent-hash placement of machines onto lease-registered serving
+    nodes, SLO-burn-driven drain, and budgeted hedged failover — see
+    docs/components/gateway.md.
+    """
+    from gordo_tpu.server.gateway import run_gateway
+
+    run_gateway(host=host, port=port, directory=membership_dir)
+
+
 gordo.add_command(build)
 gordo.add_command(batch_build)
 gordo.add_command(run_server_cli)
+gordo.add_command(run_gateway_cli)
 
 
 def _append_workflow_commands():

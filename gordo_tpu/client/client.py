@@ -22,6 +22,7 @@ from gordo_tpu import serializer
 from gordo_tpu.dataset import GordoBaseDataset
 from gordo_tpu.observability import tracing
 from gordo_tpu.server import utils as server_utils
+from . import io as _io_mod
 from .io import NotFound, _handle_response
 from .utils import PredictionResult
 
@@ -352,31 +353,40 @@ class Client:
         params = dict(self._params(revision), format="parquet") \
             if self.use_parquet else self._params(revision)
         headers = self._trace_headers()
-        if self.use_parquet:
-            import io as _io
 
-            files = {
-                "X": _io.BytesIO(
-                    server_utils.dataframe_into_parquet_bytes(X)
-                ),
-            }
-            if y is not None:
-                files["y"] = _io.BytesIO(
-                    server_utils.dataframe_into_parquet_bytes(y)
+        def _attempt():
+            # body objects are rebuilt per attempt: a 503 retry must not
+            # re-send consumed BytesIO streams
+            if self.use_parquet:
+                import io as _io
+
+                files = {
+                    "X": _io.BytesIO(
+                        server_utils.dataframe_into_parquet_bytes(X)
+                    ),
+                }
+                if y is not None:
+                    files["y"] = _io.BytesIO(
+                        server_utils.dataframe_into_parquet_bytes(y)
+                    )
+                resp = self.session.post(
+                    url, files=files, params=params, headers=headers,
+                    timeout=self.timeout,
                 )
-            resp = self.session.post(
-                url, files=files, params=params, headers=headers,
-                timeout=self.timeout,
-            )
-        else:
-            payload = {"X": server_utils.dataframe_to_dict(X)}
-            if y is not None:
-                payload["y"] = server_utils.dataframe_to_dict(y)
-            resp = self.session.post(
-                url, json=payload, params=params, headers=headers,
-                timeout=self.timeout,
-            )
-        content = _handle_response(resp, f"prediction for {name}")
+            else:
+                payload = {"X": server_utils.dataframe_to_dict(X)}
+                if y is not None:
+                    payload["y"] = server_utils.dataframe_to_dict(y)
+                resp = self.session.post(
+                    url, json=payload, params=params, headers=headers,
+                    timeout=self.timeout,
+                )
+            return _handle_response(resp, f"prediction for {name}")
+
+        # a 503 naming a Retry-After horizon (shed gate, open breaker,
+        # gateway with no live nodes) is retried within the fault policy's
+        # attempt budget instead of surfacing immediately
+        content = _io_mod.call_with_retry_after(_attempt)
         if isinstance(content, bytes):
             return server_utils.dataframe_from_parquet_bytes(content)
         return server_utils.dataframe_from_dict(content["data"])

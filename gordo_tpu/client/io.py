@@ -4,9 +4,20 @@ HTTP plumbing for the client: error taxonomy and response handling.
 Reference parity: gordo-client's ``io`` module surface used by the reference
 tests (tests/gordo/client/test_client.py:18-24 imports _handle_response,
 HttpUnprocessableEntity, BadGordoRequest, NotFound, ResourceGone).
+
+On top of the reference surface: a 503 that *names a retry horizon*
+(``Retry-After`` — the server's shed gate, an open circuit breaker, or
+the gateway with no live nodes) raises :class:`ServerBusy` instead of a
+bare ``IOError``, and :func:`call_with_retry_after` spends a bounded
+number of retries on it, sleeping the longer of the server's horizon and
+the ``FaultPolicy`` backoff (util/faults.py — the same knobs as the
+build-side retries: ``GORDO_TPU_FAULT_MAX_ATTEMPTS`` etc.).
 """
 
-from typing import Any
+import time
+from typing import Any, Callable, Optional
+
+from gordo_tpu.util import faults
 
 
 class HttpUnprocessableEntity(Exception):
@@ -23,6 +34,46 @@ class NotFound(Exception):
 
 class ResourceGone(Exception):
     """Resource moved or removed (410) — e.g. an expired revision."""
+
+
+class ServerBusy(IOError):
+    """503 carrying a server-named retry horizon (``Retry-After``): the
+    shed gate, an open breaker, or a gateway with no live nodes. Retrying
+    after the horizon has a real chance; surfacing immediately does not."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+def call_with_retry_after(
+    fn: Callable[[], Any],
+    policy: Optional[faults.FaultPolicy] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``fn`` with a bounded retry on :class:`ServerBusy`.
+
+    The sleep before each retry is the *longer* of the server's
+    ``Retry-After`` horizon (capped at the policy's backoff ceiling — a
+    server must not be able to park the client for minutes) and the
+    policy's own exponential backoff, so repeated busy answers still back
+    off even when the server keeps naming the same short horizon.
+    """
+    policy = policy or faults.FaultPolicy.from_env()
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except ServerBusy as exc:
+            if attempt >= policy.max_attempts:
+                raise
+            delay = policy.backoff(attempt, key="retry-after")
+            if exc.retry_after_s is not None:
+                delay = max(
+                    delay, min(exc.retry_after_s, policy.backoff_max)
+                )
+            sleep(delay)
+            attempt += 1
 
 
 def _handle_response(resp: Any, resource_name: str = "") -> Any:
@@ -58,4 +109,12 @@ def _handle_response(resp: Any, resource_name: str = "") -> Any:
         raise ResourceGone(msg)
     if 400 <= resp.status_code <= 499:
         raise BadGordoRequest(msg)
+    if resp.status_code == 503:
+        retry_after = resp.headers.get("Retry-After")
+        if retry_after is not None:
+            try:
+                seconds: Optional[float] = max(0.0, float(retry_after))
+            except (TypeError, ValueError):
+                seconds = None  # HTTP-date form: retry on backoff alone
+            raise ServerBusy(msg, retry_after_s=seconds)
     raise IOError(msg)

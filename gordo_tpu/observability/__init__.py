@@ -4,6 +4,7 @@ from gordo_tpu.observability import tracing  # noqa: F401
 from gordo_tpu.observability.grafana import (  # noqa: F401
     build_dashboard,
     fleet_dashboard,
+    gateway_dashboard,
     machines_dashboard,
     resilience_dashboard,
     servers_dashboard,

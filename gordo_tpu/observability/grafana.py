@@ -834,6 +834,178 @@ def fleet_dashboard() -> Dict[str, Any]:
     return _dashboard("Gordo TPU fleet", "gordo-tpu-fleet", panels)
 
 
+def gateway_dashboard() -> Dict[str, Any]:
+    """Serving gateway dashboard (ISSUE 12) over the gordo_gateway_*
+    family (server/gateway.py): ring occupancy, per-node liveness and
+    latency burn, hedge/failover rates, drain events and breaker state.
+    Gateway series live in the telemetry registry with node/reason/state
+    labels and no project label — panels query unselected names."""
+    panels = [
+        _timeseries(
+            "Routed requests by node and status",
+            [
+                {
+                    "expr": "sum(rate(gordo_gateway_requests_total[1m])) "
+                    "by (node, status)",
+                    "legend": "{{node}} {{status}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="reqps",
+            description=(
+                'node="none" marks requests the gateway answered itself: '
+                "no live nodes (503) or every replica failed (502)"
+            ),
+        ),
+        _timeseries(
+            "Proxy latency p50 / p99",
+            [
+                {
+                    "expr": (
+                        "histogram_quantile(0.5, sum(rate("
+                        "gordo_gateway_proxy_seconds_bucket[5m]"
+                        ")) by (le, node))"
+                    ),
+                    "legend": "p50 {{node}}",
+                },
+                {
+                    "expr": (
+                        "histogram_quantile(0.99, sum(rate("
+                        "gordo_gateway_proxy_seconds_bucket[5m]"
+                        ")) by (le, node))"
+                    ),
+                    "legend": "p99 {{node}}",
+                },
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            unit="s",
+            description=(
+                "Gateway-side wall time per routed request (placement + "
+                "upstream + any hedge); compare against the node-side "
+                "serving histograms for the routing overhead"
+            ),
+        ),
+        _timeseries(
+            "Ring occupancy by node",
+            [
+                {
+                    "expr": "max(gordo_gateway_ring_share) by (node)",
+                    "legend": "{{node}}",
+                }
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+            unit="percentunit",
+            description=(
+                "Fraction of the consistent-hash ring each node owns "
+                "(GORDO_TPU_GATEWAY_VNODES smooths this); a dead node's "
+                "share redistributes to its ring successors"
+            ),
+        ),
+        _timeseries(
+            "Node health & latency burn",
+            [
+                {
+                    "expr": "max(gordo_gateway_nodes) by (state)",
+                    "legend": "{{state}} nodes",
+                },
+                {
+                    "expr": "max(gordo_gateway_node_latency_burn_rate) "
+                    "by (node)",
+                    "legend": "burn {{node}}",
+                },
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            description=(
+                "Per-node 5m latency burn from each node's /debug/slo; "
+                "past GORDO_TPU_GATEWAY_DRAIN_BURN the node is marked "
+                "draining and its segment pre-warms on the successors"
+            ),
+        ),
+        _timeseries(
+            "Hedges and failovers",
+            [
+                {
+                    "expr": "sum(rate(gordo_gateway_hedges_total[5m])) "
+                    "by (reason)",
+                    "legend": "hedge {{reason}}",
+                },
+                {
+                    "expr": "sum(rate(gordo_gateway_failovers_total[5m])) "
+                    "by (node)",
+                    "legend": "failover from {{node}}",
+                },
+            ],
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+            description=(
+                "A hedge is one budgeted retry against the next ring "
+                "replica (connect failure or upstream 503); sustained "
+                "failovers from one node mean its shard is being served "
+                "by successors"
+            ),
+        ),
+        _timeseries(
+            "Drain events & breaker state",
+            [
+                {
+                    "expr": "sum(rate(gordo_gateway_drain_events_total"
+                    "[5m])) by (node)",
+                    "legend": "drain {{node}}",
+                },
+                {
+                    "expr": "max(gordo_gateway_breaker_state) by (node)",
+                    "legend": "breaker {{node}}",
+                },
+            ],
+            panel_id=6,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+            description=(
+                "Breaker state: 0 closed, 0.5 half-open (one probe in "
+                "flight), 1 open (node skipped at placement)"
+            ),
+        ),
+        _stat(
+            "Live nodes",
+            'max(gordo_gateway_nodes{state="live"})',
+            panel_id=7,
+            x=0,
+            y=3 * _PANEL_H,
+        ),
+        _stat(
+            "Draining nodes",
+            'max(gordo_gateway_nodes{state="draining"})',
+            panel_id=8,
+            x=6,
+            y=3 * _PANEL_H,
+        ),
+        _stat(
+            "Prewarm touches",
+            "sum(gordo_gateway_prewarm_total)",
+            panel_id=9,
+            x=_PANEL_W,
+            y=3 * _PANEL_H,
+        ),
+        _stat(
+            "Failovers (total)",
+            "sum(gordo_gateway_failovers_total)",
+            panel_id=10,
+            x=_PANEL_W + 6,
+            y=3 * _PANEL_H,
+        ),
+    ]
+    return _dashboard("Gordo TPU gateway", "gordo-tpu-gateway", panels)
+
+
 def write_dashboards(out_dir: str) -> List[str]:
     """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -844,6 +1016,7 @@ def write_dashboards(out_dir: str) -> List[str]:
         ("gordo_tpu_build.json", build_dashboard),
         ("gordo_tpu_resilience.json", resilience_dashboard),
         ("gordo_tpu_fleet.json", fleet_dashboard),
+        ("gordo_tpu_gateway.json", gateway_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

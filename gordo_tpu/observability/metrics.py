@@ -350,3 +350,71 @@ SLO_LATENCY_BURN = telemetry.gauge(
     "(>1 means the p99 objective is being missed)",
     ("model", "window"),
 )
+
+# ----------------------------------------------------------- serving gateway
+# the cross-node gateway (server/gateway.py): consistent-hash placement over
+# lease-registered nodes, hedged failover, SLO-burn-driven drain. Naming
+# contract extension: ``gordo_gateway_*`` for the routing tier (the lint and
+# the gateway dashboard read these same objects).
+GATEWAY_REQUESTS = telemetry.counter(
+    "gordo_gateway_requests_total",
+    "Requests routed through the gateway, by upstream node and response "
+    "status (status 502 with node 'none' means no live node could serve)",
+    ("node", "status"),
+)
+GATEWAY_PROXY_SECONDS = telemetry.histogram(
+    "gordo_gateway_proxy_seconds",
+    "End-to-end gateway routing time per request (placement + upstream "
+    "proxy + any hedged retry), by upstream node that finally answered",
+    ("node",),
+)
+GATEWAY_HEDGES = telemetry.counter(
+    "gordo_gateway_hedges_total",
+    "Budgeted hedge attempts: requests re-sent to the next replica in ring "
+    "order, by trigger (connect, status_503, transient)",
+    ("reason",),
+)
+GATEWAY_FAILOVERS = telemetry.counter(
+    "gordo_gateway_failovers_total",
+    "Requests answered by a replica other than their ring-primary node, "
+    "by the node that was failed away from",
+    ("node",),
+)
+GATEWAY_NODES = telemetry.gauge(
+    "gordo_gateway_nodes",
+    "Membership-directory node counts by state (live, draining, dead); "
+    "dead = lease older than GORDO_TPU_LEASE_TIMEOUT_S",
+    ("state",),
+)
+GATEWAY_RING_SHARE = telemetry.gauge(
+    "gordo_gateway_ring_share",
+    "Fraction of the consistent-hash ring owned by each live node "
+    "(vnode-weighted; sums to 1 over the fleet)",
+    ("node",),
+)
+GATEWAY_DRAIN_EVENTS = telemetry.counter(
+    "gordo_gateway_drain_events_total",
+    "Graceful-drain transitions: a node's latency burn crossed "
+    "GORDO_TPU_GATEWAY_DRAIN_BURN and its ring segment spilled to "
+    "neighbors",
+    ("node",),
+)
+GATEWAY_NODE_BURN = telemetry.gauge(
+    "gordo_gateway_node_latency_burn_rate",
+    "Worst-model 5m latency burn rate per node as read from its "
+    "/debug/slo endpoint by the gateway health poller",
+    ("node",),
+)
+GATEWAY_BREAKER_STATE = telemetry.gauge(
+    "gordo_gateway_breaker_state",
+    "Per-node gateway circuit breaker: 0 closed, 1 open "
+    "(0.5 half-open probe window)",
+    ("node",),
+)
+GATEWAY_PREWARMS = telemetry.counter(
+    "gordo_gateway_prewarm_total",
+    "Successor pre-warm touches issued when a node starts draining "
+    "(metadata pre-registration on the machine's next replica), by "
+    "warmed node",
+    ("node",),
+)

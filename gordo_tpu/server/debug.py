@@ -1,7 +1,7 @@
 """
 Read-only introspection endpoints: the operator's first stop on a pager.
 
-Three routes, all gated by ``GORDO_TPU_DEBUG_ENDPOINTS=1`` (without it
+All routes are gated by ``GORDO_TPU_DEBUG_ENDPOINTS=1`` (without it
 they answer 404 exactly like unknown paths — a production server exposes
 nothing new by default):
 
@@ -23,8 +23,15 @@ nothing new by default):
   and burn rates against the configured objectives
   (observability/slo.py): this process's view always, plus the merged
   fleet view when ``GORDO_TPU_TELEMETRY_DIR`` shards are active.
+- ``POST /debug/prewarm?machine=<name>`` — the one deliberate exception
+  to read-only: run the warmup pre-registration (server/warmup.py —
+  serving-program compiles, param-bank pinning, AOT pre-lowering) for
+  one machine (or the whole collection without ``machine``). The
+  gateway calls this on a draining node's ring successors so the
+  spilled segment lands warm; warming caches is the endpoint's entire
+  point and it mutates nothing else.
 
-Everything here is read-only: no handler mutates server state (the
+Everything else here is read-only: no handler mutates server state (the
 telemetry-shard flush a fleet view triggers only refreshes this
 process's own shard file).
 """
@@ -61,7 +68,7 @@ def _json(payload: Dict[str, Any], status: int = 200) -> Response:
     )
 
 
-def dispatch(endpoint: str, config: Dict[str, Any]) -> Response:
+def dispatch(endpoint: str, config: Dict[str, Any], request=None) -> Response:
     """Route one ``debug_*`` endpoint; 404 when the gate is off."""
     if not enabled():
         # indistinguishable from an unknown route: the debug surface is
@@ -73,6 +80,8 @@ def dispatch(endpoint: str, config: Dict[str, Any]) -> Response:
         return vars_view(config)
     if endpoint == "debug_slo":
         return slo_view()
+    if endpoint == "debug_prewarm":
+        return prewarm_view(config, request)
     return config_view()
 
 
@@ -140,6 +149,26 @@ def slo_view() -> Response:
         shared.flush(force=True)
         payload["fleet"] = slo.merge_payloads(shared.fleet_extras("slo"))
     return _json(payload)
+
+
+# ------------------------------------------------------------- /debug/prewarm
+def prewarm_view(config: Dict[str, Any], request=None) -> Response:
+    """Warm one machine's (or the whole collection's) serving programs
+    through the standard warmup pre-registration — the gateway's
+    successor pre-warm target."""
+    machine = request.args.get("machine") if request is not None else None
+    collection_dir = config.get("MODEL_COLLECTION_DIR")
+    if not collection_dir:
+        return _json({"error": "MODEL_COLLECTION_DIR unset"}, status=409)
+    from gordo_tpu.server.warmup import warmup_collection
+
+    try:
+        result = warmup_collection(
+            collection_dir, names=[machine] if machine else None
+        )
+    except Exception as exc:  # noqa: BLE001 — warming is best-effort
+        return _json({"error": str(exc)}, status=500)
+    return _json(result)
 
 
 # -------------------------------------------------------------- /debug/config

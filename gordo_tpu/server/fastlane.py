@@ -359,7 +359,8 @@ class FastLaneServer:
         buf = bytearray()
         try:
             while not self._shutdown.is_set():
-                if not buf:
+                idle_wait = not buf
+                if idle_wait:
                     # between requests: the keep-alive idle bound applies,
                     # not the (longer) request timeout
                     conn.settimeout(self.idle_timeout)
@@ -370,7 +371,13 @@ class FastLaneServer:
                 except _ConnectionClosed:
                     break
                 except socket.timeout:
-                    if not buf:
+                    if idle_wait and buf:
+                        # request bytes arrived during the idle wait (the
+                        # drain-vs-idle race): the connection is mid-request
+                        # now, so re-enter under the request timeout and
+                        # serve it instead of closing on the idle bound
+                        continue
+                    if idle_wait:
                         metric_catalog.FASTLANE_IDLE_CLOSES.inc()
                     break
                 finally:
@@ -733,6 +740,11 @@ class EventLoopServer(FastLaneServer):
                     last_sweep = now
                     self._sweep_idle(now)
         finally:
+            if resilience.is_draining():
+                # a drain's last responses may still sit in conn.out (the
+                # dispatch finished before the bytes hit the socket): flush
+                # them within a bounded window before tearing down
+                self._drain_flush()
             for conn in list(self._conns.values()):
                 self._close(conn)
             try:
@@ -954,9 +966,44 @@ class EventLoopServer(FastLaneServer):
             stalled = now - conn.last_activity
             if conn.mid_request():
                 if stalled > self.request_timeout:
-                    self._close(conn)
+                    self._flush_then_close(conn)
             elif stalled > self.idle_timeout:
-                self._close(conn, idle=True)
+                self._flush_then_close(conn, idle=True)
+
+    def _flush_then_close(self, conn: _Conn, idle: bool = False):
+        """Close a swept connection without dropping buffered response
+        bytes (the drain-vs-idle race): a connection selected for closing
+        while a response is still flushing gets one more write pass, and
+        during a drain the close is deferred to the writable callback
+        (bounded by the drain's own budget) instead of truncating the
+        connection's last response."""
+        if conn.out and conn.sock.fileno() >= 0:
+            self._flush(conn)
+            if conn.sock.fileno() < 0:
+                return  # the flush completed and close_after_flush closed it
+            if conn.out and resilience.is_draining():
+                conn.close_after_flush = True
+                self._want(
+                    conn, selectors.EVENT_READ | selectors.EVENT_WRITE
+                )
+                return
+        self._close(conn, idle=idle)
+
+    def _drain_flush(self, budget_s: float = 1.0):
+        """Shutdown-path counterpart of :meth:`_flush_then_close`: before
+        the loop closes every connection, give buffered responses (a
+        drain's last writes) a bounded window to reach the socket."""
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            pending = [
+                conn for conn in self._conns.values()
+                if conn.out and conn.sock.fileno() >= 0
+            ]
+            if not pending:
+                return
+            for conn in pending:
+                self._flush(conn)
+            time.sleep(0.01)
 
 
 def make_server(app, host: str, port: int, fd: Optional[int] = None

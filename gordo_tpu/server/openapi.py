@@ -261,6 +261,21 @@ def openapi_document() -> dict:
                     },
                 }
             },
+            "/debug/prewarm": {
+                "post": {
+                    "summary": "Warm the serving caches for one machine "
+                    "(?machine=<name>) or the whole collection: program "
+                    "compile, param-bank pin, AOT pre-lower — the "
+                    "gateway's successor pre-warm hook; gated by "
+                    "GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "Warmup summary JSON"},
+                        "404": {"description": "Debug endpoints disabled"},
+                        "409": {"description": "No model collection "
+                                "configured"},
+                    },
+                }
+            },
             "/metrics": {
                 "get": {"summary": "Prometheus metrics (when enabled), or "
                         "the merged fleet exposition when telemetry shards "

@@ -193,6 +193,7 @@ class GordoServer:
             Rule("/debug/vars", endpoint="debug_vars"),
             Rule("/debug/config", endpoint="debug_config"),
             Rule("/debug/slo", endpoint="debug_slo"),
+            Rule("/debug/prewarm", endpoint="debug_prewarm"),
             Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
             Rule(
                 "/gordo/v0/<gordo_project>/models",
@@ -538,7 +539,9 @@ class GordoServer:
                 elif endpoint.startswith("debug_"):
                     from gordo_tpu.server import debug
 
-                    response = debug.dispatch(endpoint, self.config)
+                    response = debug.dispatch(
+                        endpoint, self.config, request=request
+                    )
                 elif endpoint == "metrics":
                     if self._prometheus is not None:
                         response = Response(
@@ -761,11 +764,40 @@ def run_server(
         except OSError:  # pragma: no cover - double-close on some paths
             pass
 
+    def _register_node(listen_sock):
+        """Gateway membership (server/membership.py): when
+        ``GORDO_TPU_GATEWAY_DIR`` is set, this server heartbeats a lease
+        in the shared directory so the gateway places its ring shard
+        here; the registration is withdrawn on exit (graceful leave —
+        the gateway re-places the shard on the next membership poll
+        instead of waiting out the lease timeout). One lease per server,
+        held by the process that owns the listening socket: workers
+        share the socket, so the pool is one node."""
+        from gordo_tpu.server import membership
+
+        directory = membership.gateway_dir()
+        if not directory:
+            return None
+        advertise = os.environ.get("GORDO_TPU_GATEWAY_ADVERTISE")
+        if not advertise:
+            bind_host = (
+                socket.gethostname() if host in ("0.0.0.0", "::") else host
+            )
+            advertise = f"{bind_host}:{listen_sock.getsockname()[1]}"
+        try:
+            return membership.NodeRegistration(directory, address=advertise)
+        except OSError:
+            logger.exception(
+                "gateway registration failed; serving without membership"
+            )
+            return None
+
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.bind((host, port))
     sock.listen(max(128, worker_connections))
 
+    registration = _register_node(sock)
     logger.info(
         "Starting server on %s:%s with %d worker(s)", host, port, workers
     )
@@ -775,8 +807,12 @@ def run_server(
         _maybe_warmup()
         server = _make_http_server(app, sock)
         _install_drain_handler(server)
-        server.serve_forever()
-        _finish_drain(server)
+        try:
+            server.serve_forever()
+            _finish_drain(server)
+        finally:
+            if registration is not None:
+                registration.close()
         return
 
     # Prefork pool with a pure arbiter parent (the reference's gunicorn
@@ -969,3 +1005,5 @@ def run_server(
                 os.waitpid(pid, 0)
             except ChildProcessError:
                 pass
+        if registration is not None:
+            registration.close()

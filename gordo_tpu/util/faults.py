@@ -72,6 +72,18 @@ Elastic-scheduler site (ISSUE 10, parallel/batch_trainer.py):
 unit (machine matched against the unit's members) — pair it with
 ``error="die"`` to kill a host at a deterministic point mid-build and
 exercise the lease-expiry steal path.
+
+Gateway sites (ISSUE 12, server/gateway.py + server/membership.py):
+``gateway_route`` fires at the top of gateway routing (machine = the
+placement key, i.e. the machine name) — an injected transient becomes a
+503 with ``Retry-After``, exercising the client's bounded-retry path;
+``node_partition`` fires just before each upstream connect (machine =
+the target node id) — the gateway treats it as a connect failure and
+spends its hedge on the next replica in ring order; ``node_dead`` fires
+inside a serving node's membership heartbeat (machine = node id) — any
+injected error stops the heartbeat and runs the registration's
+``on_dead`` callback, the in-process stand-in for kill -9 (the lease
+goes stale and the gateway spills the node's ring segment).
 """
 
 import json

@@ -65,6 +65,15 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # an unchanged p99
     ("server_load_fastlane_p50_ms", False),
     ("server_load_fastlane_p999_ms", False),
+    # cross-node serving gateway arm (ISSUE 12): routed throughput and
+    # tail gate like the direct arms; the p50 overhead over the direct
+    # fast-lane arm and the kill-a-node recovery time gate as
+    # lower-is-better. Absent in pre-gateway records, so they only gate
+    # once both sides of a pair carry them.
+    ("server_gateway_req_per_sec", True),
+    ("server_gateway_p99_ms", False),
+    ("server_gateway_p50_overhead_ms", False),
+    ("server_gateway_recovery_s", False),
     # fleet-plane merged view of the same load (ISSUE 9): the merged p99
     # gates like the harness-side p99; the burn rates are ratios where
     # lower is better (burn 1.0 = consuming budget exactly as allowed)
@@ -93,7 +102,7 @@ def metric_section(key: str, parsed: dict) -> Optional[str]:
         return "headline"
     if key in _SERVING_METRICS:
         return parsed.get("serving_source")
-    if key.startswith(("server_load_", "server_fleet_")):
+    if key.startswith(("server_load_", "server_fleet_", "server_gateway_")):
         return "serving_load"
     if key.startswith("fleet_build_"):
         return "fleet_build"

@@ -50,6 +50,12 @@ _NUMERIC_KEYS = (
     "server_load_fastlane_req_per_sec", "server_load_fastlane_p50_ms",
     "server_load_fastlane_p99_ms", "server_load_fastlane_p999_ms",
     "server_load_trace_compiles_steady",
+    # the cross-node serving gateway's arm of serving_load (ISSUE 12):
+    # routed percentiles, overhead over the direct fast-lane arm, and
+    # the kill-a-node recovery time
+    "server_gateway_req_per_sec", "server_gateway_p50_ms",
+    "server_gateway_p99_ms", "server_gateway_p50_overhead_ms",
+    "server_gateway_recovery_s",
     # the fleet observability plane's merged view of the load (ISSUE 9);
     # peak_source rides alongside but is a string tag, not a number
     "server_fleet_workers", "server_fleet_requests_total",

@@ -221,7 +221,9 @@ def test_bench_emit_record_partial_sections(capsys, tmp_path, monkeypatch):
     assert line["server_p50_anomaly_ms"] == 3.0
     assert line["tpu_smoke"]["flash_ok"] is True
     assert line["skipped_for_budget"] == ["windowed"]
-    assert len(json.dumps(line)) < 1024 * 2
+    # the compact line must stay one readable stdout line (the gateway
+    # arm's flat keys pushed the null-valued skeleton past 2 KiB)
+    assert len(json.dumps(line)) < 1024 * 3
 
 
 def test_bench_section_crash_partial_recovery(monkeypatch):
@@ -589,6 +591,17 @@ def test_bench_serving_load_section(monkeypatch, tmp_path):
     assert fastlane_qps["errors"] == 0
     assert fastlane_qps["p999_ms"] >= fastlane_qps["p50_ms"] > 0
     assert fastlane_qps["flight"]["available"] is True
+    # the serving_gateway arm (ISSUE 12): same schedule routed through
+    # the consistent-hash gateway over two lease-registered nodes, then
+    # the machine's ring primary is killed and recovery is timed
+    gateway = result["gateway"]
+    assert "error" not in gateway, gateway
+    assert gateway["requests"] > 0
+    assert gateway["nodes"] == 2
+    assert gateway["p99_ms"] >= gateway["p50_ms"] > 0
+    assert gateway["p50_overhead_ms"] is not None
+    assert gateway["recovery_s"] is not None
+    assert gateway["recovery_s"] < 10.0
 
 
 # ------------------------------------------------------- bench_compare gate
@@ -709,6 +722,26 @@ def test_bench_compare_gates_on_load_tail_regression(tmp_path):
         statuses={"serving_load": "skipped_for_budget"},
     )
     assert _run_compare(old, skipped).returncode == 0
+
+
+def test_bench_compare_gates_on_gateway_regression(tmp_path):
+    """The serving_gateway arm's keys are first-class gate inputs: a
+    blown-up node-kill recovery time or routed overhead trips the gate;
+    records predating the arm (keys absent) compare clean."""
+    old = _v2_record(tmp_path, "old.json", value=100.0,
+                     server_gateway_recovery_s=2.0,
+                     server_gateway_p50_overhead_ms=1.0)
+    new = _v2_record(tmp_path, "new.json", value=100.0,
+                     server_gateway_recovery_s=8.0,
+                     server_gateway_p50_overhead_ms=1.1)
+    result = _run_compare(old, new)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "server_gateway_recovery_s" in result.stdout
+    # pre-gateway baseline: keys absent on one side → skipped, not a gate
+    legacy = _v2_record(tmp_path, "legacy.json", value=100.0)
+    result = _run_compare(legacy, new)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "server_gateway_recovery_s: skipped" in result.stdout
 
 
 def test_bench_compare_latest_mode(tmp_path):

@@ -14,7 +14,9 @@ from gordo_tpu.client.io import (
     HttpUnprocessableEntity,
     NotFound,
     ResourceGone,
+    ServerBusy,
     _handle_response,
+    call_with_retry_after,
 )
 from gordo_tpu.client.testing import WSGISession
 from gordo_tpu.server import build_app
@@ -134,6 +136,123 @@ def test_handle_response_errors():
         _handle_response(FakeResp(400))
     with pytest.raises(IOError):
         _handle_response(FakeResp(500))
+
+
+# ----------------------------------------------- Retry-After (ISSUE 12)
+class _BusyResp:
+    """A 503 shaped like the server's shed gate / breaker / gateway
+    no-live-nodes answers: JSON body plus a Retry-After header."""
+
+    status_code = 503
+    content = b"{}"
+
+    def __init__(self, retry_after):
+        self.headers = {"Content-Type": "application/json"}
+        if retry_after is not None:
+            self.headers["Retry-After"] = retry_after
+
+    def json(self):
+        return {"error": "busy"}
+
+
+def test_handle_response_503_retry_after_raises_server_busy():
+    with pytest.raises(ServerBusy) as excinfo:
+        _handle_response(_BusyResp("3"))
+    assert excinfo.value.retry_after_s == 3.0
+    # HTTP-date form: still ServerBusy, horizon unknown → backoff alone
+    with pytest.raises(ServerBusy) as excinfo:
+        _handle_response(_BusyResp("Wed, 21 Oct 2026 07:28:00 GMT"))
+    assert excinfo.value.retry_after_s is None
+    # a 503 WITHOUT a horizon stays a plain IOError (no retry contract)
+    with pytest.raises(IOError) as excinfo:
+        _handle_response(_BusyResp(None))
+    assert not isinstance(excinfo.value, ServerBusy)
+
+
+def test_call_with_retry_after_bounded_and_honors_horizon():
+    from gordo_tpu.util import faults
+
+    policy = faults.FaultPolicy(
+        max_attempts=3, backoff_base=0.1, backoff_factor=2.0,
+        backoff_max=5.0, jitter=0.0,
+    )
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ServerBusy("busy", retry_after_s=2.0)
+        return "ok"
+
+    assert call_with_retry_after(flaky, policy, sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    # the server's horizon dominates the (shorter) exponential backoff
+    assert sleeps == [2.0, 2.0]
+
+    # bounded: max_attempts exhausted re-raises the last ServerBusy
+    calls.clear()
+    sleeps.clear()
+
+    def always_busy():
+        calls.append(1)
+        raise ServerBusy("busy", retry_after_s=0.5)
+
+    with pytest.raises(ServerBusy):
+        call_with_retry_after(always_busy, policy, sleep=sleeps.append)
+    assert len(calls) == policy.max_attempts
+    assert len(sleeps) == policy.max_attempts - 1
+
+
+def test_call_with_retry_after_caps_server_horizon():
+    """A server cannot park the client for minutes: the Retry-After
+    horizon is capped at the policy's backoff ceiling."""
+    from gordo_tpu.util import faults
+
+    policy = faults.FaultPolicy(
+        max_attempts=2, backoff_base=0.1, backoff_factor=2.0,
+        backoff_max=1.5, jitter=0.0,
+    )
+    sleeps = []
+
+    def once_busy():
+        if not sleeps:
+            raise ServerBusy("busy", retry_after_s=600.0)
+        return "ok"
+
+    assert call_with_retry_after(once_busy, policy, sleep=sleeps.append) == "ok"
+    assert sleeps == [1.5]
+
+
+def test_client_retries_503_with_retry_after(
+    app, gordo_project, gordo_name, monkeypatch
+):
+    """End to end through Client._post_to: a shed 503 naming Retry-After
+    is retried (body rebuilt per attempt) and the retry's 200 wins."""
+    monkeypatch.setenv("GORDO_TPU_FAULT_BACKOFF_BASE", "0.01")
+    state = {"calls": 0}
+    real_post = WSGISession.post
+
+    def flaky_post(self, url, **kwargs):
+        resp = real_post(self, url, **kwargs)
+        if "/prediction" in url:
+            state["calls"] += 1
+            if state["calls"] == 1:
+                resp.status_code = 503
+                resp.headers["Retry-After"] = "0"
+        return resp
+
+    monkeypatch.setattr(WSGISession, "post", flaky_post)
+    client = Client(project=gordo_project, session=WSGISession(app))
+    results = client.predict(
+        "2020-03-01T00:00:00+00:00",
+        "2020-03-02T00:00:00+00:00",
+        targets=[gordo_name],
+    )
+    assert state["calls"] >= 2  # first answer shed, retry served
+    assert len(results) == 1
+    assert results[0].error_messages == []
+    assert results[0].predictions is not None
 
 
 def test_client_cli_metadata(app, gordo_project, gordo_name, monkeypatch, tmp_path):

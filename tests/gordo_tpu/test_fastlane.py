@@ -689,6 +689,84 @@ def test_idle_keep_alive_bounded_and_counted(app, monkeypatch, lane):
         thread.join(timeout=5)
 
 
+# ---------------------------------------------- drain vs idle-sweep race
+@pytest.mark.parametrize("lane", ["threads", "event_loop"])
+def test_idle_bound_yields_to_request_in_progress(app, monkeypatch, lane):
+    """Satellite (ISSUE 12): request bytes that arrive during the idle
+    wait put the connection mid-request — the idle bound must hand over
+    to the request timeout and serve the request, not close on partial
+    head bytes. Before the fix the thread lane treated any timeout during
+    an idle wait as an idle close, truncating the in-flight request."""
+    monkeypatch.setenv("GORDO_TPU_FASTLANE_IDLE_S", "0.4")
+    cls = (
+        fastlane.EventLoopServer if lane == "event_loop"
+        else fastlane.FastLaneServer
+    )
+    server = cls(app, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        sock = socket.create_connection(
+            ("127.0.0.1", server.server_port), timeout=30
+        )
+        try:
+            # partial head: no terminating blank line yet
+            sock.sendall(b"GET /healthcheck HTTP/1.1\r\nHost: localhost\r\n")
+            time.sleep(1.2)  # several idle bounds elapse mid-request
+            sock.sendall(b"\r\n")
+            status, _ = _read_one_response(sock.makefile("rb"))
+            assert status == 200
+        finally:
+            sock.close()
+    finally:
+        server.server_close()
+        thread.join(timeout=5)
+
+
+def test_sweep_flushes_buffered_response_during_drain(app):
+    """Satellite (ISSUE 12): a connection the sweep selects for closing
+    while a drain is flushing its last response must flush-then-close —
+    the buffered bytes reach the client in full instead of being dropped
+    by a hard close. Drives the event loop's sweep and writable callback
+    directly so the partial-write state is deterministic."""
+    import selectors
+
+    server = fastlane.EventLoopServer(app, host="127.0.0.1", port=0)
+    client, srv_side = socket.socketpair()
+    srv_side.setblocking(False)
+    # large enough that one flush pass cannot complete the write
+    body = b"x" * (4 << 20)
+    payload = fastlane._serialize(
+        200, [("Content-Type", "text/plain")], body, keep_alive=False
+    )
+    conn = fastlane._Conn(srv_side)
+    conn.out += payload
+    conn.close_after_flush = True
+    conn.last_activity = time.monotonic() - 10_000  # far past every bound
+    server._conns[srv_side.fileno()] = conn
+    server._selector.register(srv_side, selectors.EVENT_READ, conn)
+    assert resilience.begin_drain()
+    try:
+        server._sweep_idle(time.monotonic())
+        received = bytearray()
+        client.settimeout(5)
+        while True:
+            if srv_side.fileno() >= 0:
+                server._flush(conn)  # the loop's writable callback
+            try:
+                chunk = client.recv(1 << 20)
+            except socket.timeout:
+                pytest.fail("connection stalled with response bytes pending")
+            if not chunk:
+                break
+            received += chunk
+        assert bytes(received) == bytes(payload)
+    finally:
+        resilience.reset_for_tests()
+        client.close()
+        server.server_close()
+
+
 # ------------------------------------------------- observability parity
 def test_observability_parity_between_lanes(
     wsgi_client, fast_server, gordo_project, gordo_name, X_payload

@@ -1,0 +1,563 @@
+"""
+Gateway acceptance and unit tests (ISSUE 12).
+
+The acceptance drive is the chaos test at the bottom: a 3-node
+in-process fleet behind one :class:`GatewayServer` under open-loop load,
+one node killed mid-storm through the ``node_dead`` fault site. The
+contract being asserted is the issue's acceptance criteria verbatim:
+requests for machines on healthy shards never fail, the killed shard is
+served again (by its ring successor, via the hedged failover) within one
+lease timeout, the gateway notices the death within the lease timeout
+plus a poll tick, and the error rate over the whole storm stays bounded
+— all observed through the gateway's own ``/metrics``.
+
+The unit tests above it pin the pieces the chaos test composes: ring
+determinism and minimal movement, lease staleness and generation
+fencing, breaker state transitions, placement-key parsing, and the
+``gateway_route`` injection site.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from gordo_tpu.server import gateway, membership
+from gordo_tpu.util import faults
+
+
+# ------------------------------------------------------------------ ring
+def test_ring_candidates_deterministic_and_distinct():
+    ring = gateway.HashRing(vnodes=32)
+    ring.rebuild(["node-a", "node-b", "node-c"])
+    for key in ("m-001", "m-002", "some/path"):
+        order = ring.candidates(key)
+        assert sorted(order) == ["node-a", "node-b", "node-c"]
+        assert order == ring.candidates(key)  # stable across calls
+    assert ring.candidates("m-001", limit=2) == ring.candidates("m-001")[:2]
+
+
+def test_ring_share_sums_to_one_and_tracks_vnodes():
+    ring = gateway.HashRing(vnodes=64)
+    ring.rebuild(["node-a", "node-b", "node-c"])
+    share = ring.share()
+    assert set(share) == {"node-a", "node-b", "node-c"}
+    assert sum(share.values()) == pytest.approx(1.0)
+    # vnode weighting keeps occupancy roughly balanced
+    assert all(0.1 < s < 0.7 for s in share.values())
+
+
+def test_ring_minimal_movement_on_node_loss():
+    """Removing one node must only move the keys it owned — every other
+    key keeps its primary (and therefore its node-side caches)."""
+    keys = [f"m-{i:03d}" for i in range(200)]
+    ring = gateway.HashRing(vnodes=64)
+    ring.rebuild(["node-a", "node-b", "node-c"])
+    before = {k: ring.candidates(k)[0] for k in keys}
+    ring.rebuild(["node-a", "node-c"])
+    after = {k: ring.candidates(k)[0] for k in keys}
+    for key in keys:
+        if before[key] != "node-b":
+            assert after[key] == before[key]
+        else:
+            assert after[key] in ("node-a", "node-c")
+
+
+def test_empty_ring_has_no_candidates():
+    ring = gateway.HashRing(vnodes=8)
+    assert ring.candidates("m-001") == []
+    assert ring.share() == {}
+
+
+# ------------------------------------------------------------ membership
+def test_membership_register_heartbeat_withdraw(tmp_path, monkeypatch):
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.0")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.1")
+    view = membership.MembershipView(str(tmp_path))
+    reg = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:5555", node_id="node-a"
+    )
+    try:
+        nodes = view.poll()
+        assert nodes["node-a"].alive
+        assert nodes["node-a"].address == "127.0.0.1:5555"
+        assert nodes["node-a"].host == "127.0.0.1"
+        assert nodes["node-a"].port == 5555
+        assert [n.node_id for n in view.live_nodes()] == ["node-a"]
+    finally:
+        reg.close()
+    # graceful leave withdraws the file: gone on the next poll, no
+    # lease-timeout wait
+    assert "node-a" not in view.poll()
+
+
+def test_membership_stale_lease_is_dead(tmp_path, monkeypatch):
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "0.4")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.1")
+    view = membership.MembershipView(str(tmp_path))
+    reg = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:5555", node_id="node-a"
+    )
+    try:
+        assert view.poll()["node-a"].alive
+        # stop the heartbeat WITHOUT withdrawing (the kill -9 shape):
+        # the file stays but its mtime goes stale
+        reg._stop.set()
+        reg._thread.join(timeout=2.0)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            info = view.poll().get("node-a")
+            if info is not None and not info.alive:
+                break
+            time.sleep(0.05)
+        info = view.poll()["node-a"]
+        assert not info.alive
+        assert info.age_s > 0.4
+        assert view.live_nodes() == []
+    finally:
+        reg.close()
+
+
+def test_membership_generation_fencing(tmp_path, monkeypatch):
+    """A restarted twin takes generation+1; the old holder sees itself
+    superseded and stops heartbeating, and readers follow the newest
+    generation's address."""
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "5.0")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.1")
+    view = membership.MembershipView(str(tmp_path))
+    old = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:1111", node_id="node-a"
+    )
+    new = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:2222", node_id="node-a"
+    )
+    try:
+        assert new.generation == old.generation + 1
+        assert not old.still_current()
+        assert new.still_current()
+        info = view.poll()["node-a"]
+        assert info.generation == new.generation
+        assert info.address == "127.0.0.1:2222"
+        # the fenced holder's heartbeat thread exits on its own
+        old._thread.join(timeout=2.0)
+        assert not old._thread.is_alive()
+    finally:
+        new.close()
+        old.close()
+
+
+def test_membership_tolerates_stray_files(tmp_path):
+    nodes_dir = tmp_path / "nodes"
+    nodes_dir.mkdir()
+    (nodes_dir / "not-a-lease").write_text("junk")
+    (nodes_dir / "half-written.g2").write_text("{truncated")
+    view = membership.MembershipView(str(tmp_path))
+    assert view.poll() == {}
+
+
+# --------------------------------------------------------------- breaker
+def test_breaker_opens_on_consecutive_transients_and_half_opens():
+    breaker = gateway.NodeBreaker("node-a", threshold=2, cooldown_s=0.2)
+    assert breaker.allow()
+    breaker.record_failure(faults.TransientFault("connect refused"))
+    assert breaker.allow()  # below threshold
+    breaker.record_failure(faults.TransientFault("connect refused"))
+    assert not breaker.allow()  # open
+    time.sleep(0.25)
+    assert breaker.allow()  # half-open: exactly one probe
+    assert not breaker.allow()  # the second concurrent probe is denied
+    breaker.record_success()
+    assert breaker.allow()  # closed again
+
+
+def test_breaker_permanent_fault_opens_immediately():
+    breaker = gateway.NodeBreaker("node-a", threshold=3, cooldown_s=60.0)
+    breaker.record_failure(faults.PermanentFault("poisoned"))
+    assert not breaker.allow()
+
+
+def test_breaker_disabled_with_zero_threshold():
+    breaker = gateway.NodeBreaker("node-a", threshold=0, cooldown_s=60.0)
+    for _ in range(10):
+        breaker.record_failure(faults.TransientFault("x"))
+    assert breaker.allow()
+
+
+# --------------------------------------------------------- placement key
+@pytest.mark.parametrize(
+    "path,expected",
+    [
+        ("/gordo/v0/proj/machine-1/prediction", ("machine-1", "proj")),
+        ("/gordo/v0/proj/machine-1/anomaly/prediction",
+         ("machine-1", "proj")),
+        ("/gordo/v0/proj/machine-1/metadata", ("machine-1", "proj")),
+        ("/gordo/v0/proj/models/", (None, "proj")),
+        ("/gordo/v0/proj/revisions/", (None, "proj")),
+        ("/healthcheck", (None, None)),
+        ("/metrics", (None, None)),
+    ],
+)
+def test_placement_key(path, expected, tmp_path):
+    server = _make_gateway(tmp_path)
+    try:
+        assert server._placement_key(path) == expected
+    finally:
+        server.server_close()
+
+
+# ------------------------------------------------------- 3-node fixture
+class _StubNode:
+    """One fake serving node: an HTTP server answering every route with
+    its own id, plus a real membership lease. ``kill()`` (the
+    ``node_dead`` on_dead callback) stops the HTTP server and closes the
+    listener so new connects are refused — the in-process kill -9."""
+
+    def __init__(self, directory: str, node_id: str):
+        self.node_id = node_id
+        self.hits = 0
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                with node._conns_lock:
+                    node._conns.add(self.connection)
+
+            def finish(self):
+                with node._conns_lock:
+                    node._conns.discard(self.connection)
+                super().finish()
+
+            def _answer(self):
+                node.hits += 1
+                body = json.dumps(
+                    {"node": node.node_id, "path": self.path}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _answer
+
+            def log_message(self, *args):  # silence
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.registration = membership.NodeRegistration(
+            directory,
+            address=f"127.0.0.1:{self.port}",
+            node_id=node_id,
+            on_dead=self.kill,
+        )
+
+    def kill(self):
+        # a real kill -9 takes the listener AND every established
+        # keep-alive connection with it — sever both, or the gateway's
+        # pooled upstream connections would keep being served by a ghost
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self.registration.close()
+        self.kill()
+        self.thread.join(timeout=2.0)
+
+
+def _make_gateway(tmp_path) -> gateway.GatewayServer:
+    return gateway.GatewayServer(str(tmp_path), host="127.0.0.1", port=0)
+
+
+def _gateway_request(server, method, path, headers=None, timeout=10):
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", server.server_port, timeout=timeout
+    )
+    try:
+        conn.request(method, path, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def fleet(tmp_path, monkeypatch):
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.5")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.2")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_HEALTH_S", "0.3")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S", "0.5")
+    faults.reset_plan()
+    nodes = [_StubNode(str(tmp_path), f"node-{c}") for c in "abc"]
+    server = _make_gateway(tmp_path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 5.0
+    while len(server.ring.nodes) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(server.ring.nodes) == 3
+    yield SimpleNamespace(server=server, nodes=nodes)
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    faults.reset_plan()
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=5)
+    for node in nodes:
+        node.close()
+
+
+# --------------------------------------------------------- routed basics
+def test_gateway_routes_by_ring_placement(fleet):
+    """A machine's requests always land on its ring primary, reported in
+    X-Gordo-Gateway-Node and visible in the stub's answer."""
+    server = fleet.server
+    for i in range(6):
+        machine = f"m-{i:03d}"
+        primary = server.ring.candidates(machine)[0]
+        status, headers, body = _gateway_request(
+            server, "GET", f"/gordo/v0/proj/{machine}/metadata"
+        )
+        assert status == 200
+        assert headers["x-gordo-gateway-node"] == primary
+        assert json.loads(body)["node"] == primary
+
+
+def test_gateway_local_endpoints(fleet):
+    server = fleet.server
+    status, _, body = _gateway_request(server, "GET", "/healthcheck")
+    assert status == 200
+    assert json.loads(body)["nodes"] == 3
+
+    status, _, body = _gateway_request(server, "GET", "/gateway/status")
+    assert status == 200
+    doc = json.loads(body)
+    assert set(doc["nodes"]) == {"node-a", "node-b", "node-c"}
+    assert sum(doc["ring"]["share"].values()) == pytest.approx(1.0)
+
+    status, headers, body = _gateway_request(server, "GET", "/metrics")
+    assert status == 200
+    assert "text/plain" in headers["content-type"]
+    assert b"gordo_gateway_requests_total" in body
+
+
+def test_gateway_no_live_nodes_is_503_retry_after(tmp_path, monkeypatch):
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.0")
+    server = _make_gateway(tmp_path)  # empty membership dir
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        status, headers, body = _gateway_request(
+            server, "GET", "/gordo/v0/proj/m-001/metadata"
+        )
+        assert status == 503
+        assert int(headers["retry-after"]) >= 1
+        assert "no live serving nodes" in json.loads(body)["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+def test_gateway_route_fault_injection(fleet, monkeypatch):
+    """The gateway_route site: an injected transient answers 503 with
+    Retry-After before any upstream is touched; the next request (rule
+    exhausted) routes normally."""
+    server = fleet.server
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        json.dumps(
+            {
+                "rules": [
+                    {
+                        "site": "gateway_route",
+                        "machine": "m-001",
+                        "times": 1,
+                        "error": "transient",
+                    }
+                ]
+            }
+        ),
+    )
+    faults.reset_plan()
+    status, headers, _ = _gateway_request(
+        server, "GET", "/gordo/v0/proj/m-001/metadata"
+    )
+    assert status == 503
+    assert headers.get("retry-after")
+    status, _, _ = _gateway_request(
+        server, "GET", "/gordo/v0/proj/m-001/metadata"
+    )
+    assert status == 200
+
+
+def test_gateway_node_partition_hedges_to_successor(fleet, monkeypatch):
+    """The node_partition site: a transient on the primary's connect path
+    spends the hedge on the next ring replica — the client still gets a
+    200, answered by the successor."""
+    server = fleet.server
+    machine = "m-007"
+    order = server.ring.candidates(machine)
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        json.dumps(
+            {
+                "rules": [
+                    {
+                        "site": "node_partition",
+                        "machine": order[0],
+                        "times": 1,
+                        "error": "transient",
+                    }
+                ]
+            }
+        ),
+    )
+    faults.reset_plan()
+    status, headers, body = _gateway_request(
+        server, "GET", f"/gordo/v0/proj/{machine}/metadata"
+    )
+    assert status == 200
+    assert headers["x-gordo-gateway-node"] == order[1]
+    assert json.loads(body)["node"] == order[1]
+
+
+# -------------------------------------------------------------- chaos
+def test_chaos_kill_one_node_healthy_shards_unharmed(fleet, monkeypatch):
+    """The acceptance drive (ISSUE 12): open-loop load over a 3-node
+    fleet, one node killed through the node_dead fault site mid-storm.
+
+    Asserted, per the issue's acceptance criteria:
+    - requests for machines on healthy shards NEVER fail;
+    - the killed shard keeps being served (hedged failover to the ring
+      successor) — first post-kill success within one lease timeout;
+    - the gateway's membership view drops the dead node within the lease
+      timeout plus a heartbeat + health-poll tick;
+    - the error rate over the killed shard is bounded, asserted from the
+      gateway's merged /metrics.
+    """
+    server = fleet.server
+    lease_timeout = 2.5
+
+    machines = [f"m-{i:03d}" for i in range(60)]
+    primaries = {m: server.ring.candidates(m)[0] for m in machines}
+    kill_node = primaries[machines[0]]
+    victims = [m for m in machines if primaries[m] == kill_node][:4]
+    healthy = [m for m in machines if primaries[m] != kill_node][:4]
+    assert victims and healthy
+
+    failover_before = sum(
+        dict(gateway.metric_catalog.GATEWAY_FAILOVERS.snapshot()).values()
+    )
+
+    results = []  # (t, machine, status, serving_node)
+    t_kill = None
+    t_detect = None
+    t0 = time.monotonic()
+    deadline = t0 + 12.0
+    i = 0
+    while time.monotonic() < deadline:
+        machine = (victims + healthy)[i % (len(victims) + len(healthy))]
+        i += 1
+        try:
+            status, headers, _ = _gateway_request(
+                server, "GET", f"/gordo/v0/proj/{machine}/metadata",
+                timeout=5,
+            )
+            node = headers.get("x-gordo-gateway-node", "")
+        except OSError:
+            status, node = -1, ""
+        results.append((time.monotonic() - t0, machine, status, node))
+
+        if t_kill is None and i >= 20:
+            monkeypatch.setenv(
+                faults.PLAN_ENV,
+                json.dumps(
+                    {
+                        "rules": [
+                            {
+                                "site": "node_dead",
+                                "machine": kill_node,
+                                "times": 1,
+                                "error": "transient",
+                            }
+                        ]
+                    }
+                ),
+            )
+            faults.reset_plan()
+            t_kill = time.monotonic() - t0
+        if t_kill is not None and t_detect is None:
+            if kill_node not in server._live:
+                t_detect = time.monotonic() - t0
+        if t_detect is not None and time.monotonic() - t0 > t_detect + 1.0:
+            break
+        time.sleep(0.015)
+
+    assert t_kill is not None
+    # membership noticed the death: stale lease dropped within the lease
+    # timeout plus a heartbeat interval and a couple of health-poll ticks
+    assert t_detect is not None, "gateway never noticed the dead node"
+    assert t_detect - t_kill <= lease_timeout + 1.5
+
+    healthy_results = [r for r in results if r[1] in healthy]
+    victim_results = [r for r in results if r[1] in victims]
+    assert healthy_results and victim_results
+
+    # healthy shards: zero failures, before and after the kill
+    assert all(r[2] == 200 for r in healthy_results), [
+        r for r in healthy_results if r[2] != 200
+    ]
+
+    # killed shard: served again within one lease timeout of the kill
+    # (in practice immediately, via the hedged failover)
+    post_kill_ok = [
+        r for r in victim_results if r[0] > t_kill and r[2] == 200
+    ]
+    assert post_kill_ok, "killed shard never recovered"
+    assert post_kill_ok[0][0] - t_kill <= lease_timeout
+    # ... and by the end it is served by a surviving node
+    tail = victim_results[-3:]
+    assert all(r[2] == 200 and r[3] != kill_node for r in tail), tail
+
+    # bounded error rate over the storm: only the brief window between
+    # the kill and the breaker/hedge taking over may fail
+    errors = [r for r in results if r[2] != 200]
+    assert len(errors) <= max(3, len(results) // 10), errors
+
+    # observed through the gateway's own merged /metrics
+    status, _, metrics_body = _gateway_request(server, "GET", "/metrics")
+    assert status == 200
+    text = metrics_body.decode()
+    assert "gordo_gateway_requests_total" in text
+    assert "gordo_gateway_failovers_total" in text
+    failover_after = sum(
+        dict(gateway.metric_catalog.GATEWAY_FAILOVERS.snapshot()).values()
+    )
+    assert failover_after > failover_before

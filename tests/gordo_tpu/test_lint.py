@@ -376,6 +376,33 @@ def test_dashboard_lint_accepts_cataloged_and_label_positions(tmp_path):
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_dashboard_lint_grounds_gateway_family(tmp_path):
+    """The gateway dashboard's ``gordo_gateway_*`` exprs are grounded by
+    the real catalog — and the reverse check is non-vacuous: against a
+    catalog without the gateway registrations, every panel is flagged."""
+    dashboards = tmp_path / "dashboards"
+    dashboards.mkdir()
+    source = (
+        REPO_ROOT / "resources" / "grafana" / "dashboards"
+        / "gordo_tpu_gateway.json"
+    )
+    (dashboards / "gordo_tpu_gateway.json").write_text(source.read_text())
+
+    real_catalog = REPO_ROOT / "gordo_tpu" / "observability" / "metrics.py"
+    result = _run_dashboard_lint(tmp_path, dashboards, real_catalog)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+    gateway_free = tmp_path / "catalog.py"
+    gateway_free.write_text(
+        "from gordo_tpu.observability import telemetry\n"
+        'a = telemetry.counter("gordo_real_total", "a real counter")\n'
+    )
+    result = _run_dashboard_lint(tmp_path, dashboards, gateway_free)
+    assert result.returncode == 1
+    assert "gordo_gateway_requests_total" in result.stdout
+    assert "gordo_gateway_proxy_seconds" in result.stdout
+
+
 def test_fleet_scrape_smoke(tmp_path, monkeypatch):
     """Tiny-budget fleet-scrape smoke: flush this process's shard, render
     the merged exposition (the exact bytes a no-prometheus /metrics

@@ -6,6 +6,7 @@ import re
 from gordo_tpu.observability import (
     build_dashboard,
     fleet_dashboard,
+    gateway_dashboard,
     machines_dashboard,
     resilience_dashboard,
     servers_dashboard,
@@ -21,6 +22,7 @@ _ALL_DASHBOARDS = (
     build_dashboard,
     resilience_dashboard,
     fleet_dashboard,
+    gateway_dashboard,
 )
 
 
@@ -55,7 +57,7 @@ def test_dashboards_reference_live_metric_names():
 
     suffix = r"(?:_bucket|_count|_sum)?"
     metric_re = re.compile(
-        r"(gordo_(?:server|build)_[a-z0-9_]+?)" + suffix + r"[{\[\s)]"
+        r"(gordo_(?:server|build|gateway)_[a-z0-9_]+?)" + suffix + r"[{\[\s)]"
     )
     for dashboard in _ALL_DASHBOARDS:
         for expr in _all_exprs(dashboard()):
@@ -93,7 +95,7 @@ def test_latency_panels_use_quantiles_not_averages():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 5
+    assert len(paths) == 6
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
@@ -114,6 +116,7 @@ def test_checked_in_dashboards_are_current():
         ("gordo_tpu_build.json", build_dashboard),
         ("gordo_tpu_resilience.json", resilience_dashboard),
         ("gordo_tpu_fleet.json", fleet_dashboard),
+        ("gordo_tpu_gateway.json", gateway_dashboard),
     ):
         with open(os.path.join(out_dir, name)) as fh:
             assert json.load(fh) == build(), f"{name} is stale — regenerate with " \
