@@ -1014,18 +1014,21 @@ def _rerun_improves(rerun: dict, original: dict) -> bool:
 # budget pressure can't cost the round its tail-latency record.
 SECTION_NAMES = (
     "tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
-    "fleet_build",
+    "fleet_build", "drift_loop",
 )
 SECTION_STATUSES = (
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",
 )
-RECORD_SCHEMA_VERSION = 3
+RECORD_SCHEMA_VERSION = 4
 # Older records stay valid against the section list of THEIR schema
 # version (the record lint looks the version up here): a v2 record has no
-# fleet_build section and must not start failing when v3 adds one.
+# fleet_build section and must not start failing when v3 adds one, nor a
+# v3 record when v4 adds drift_loop.
 SECTION_NAMES_BY_VERSION = {
     2: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"),
-    3: SECTION_NAMES,
+    3: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build"),
+    4: SECTION_NAMES,
 }
 
 
@@ -1058,6 +1061,7 @@ _SECTION_MIN_USEFUL = {
     "windowed": 600,
     "batch_ab": 300,
     "fleet_build": 240,
+    "drift_loop": 180,
 }
 
 
@@ -1097,6 +1101,13 @@ def _section_timeout(name: str) -> int:
         # two 2-worker arms over a small skewed fleet (CPU workers by
         # construction) — bounded so it can never starve the fleet sections
         timeout = min(timeout, 1500)
+    if (
+        name == "drift_loop"
+        and "BENCH_SECTION_TIMEOUT_DRIFT_LOOP" not in os.environ
+    ):
+        # two tiny model builds + one warm-start delta rebuild under a
+        # short load window — bounded like the other small sections
+        timeout = min(timeout, 900)
     if name == "windowed" and "BENCH_SECTION_TIMEOUT_WINDOWED" not in os.environ:
         # four families (LSTM AE/forecast, Transformer, TCN), each with a
         # fleet compile + steady-state build + a torch mirror — a CPU
@@ -1707,6 +1718,185 @@ def _bench_fleet_build() -> dict:
     }
 
 
+def _bench_drift_loop() -> dict:
+    """The self-healing drift loop, end to end (ISSUE 13): two tiny
+    just-built models served live over HTTP, synthetic drift injected
+    into one model's reconstruction-error stream, and the full
+    detect -> enqueue -> warm-start delta rebuild -> zero-downtime
+    hot-swap sequence timed while open-loop load keeps hitting the
+    swapped model. Reported: detection-to-swap wall time, requests
+    dropped (non-2xx or connect failure) across the whole window —
+    must be 0, the pointer flip is atomic — and the models swapped."""
+    import http.client
+    import tempfile
+    import threading
+    import wsgiref.simple_server
+
+    from gordo_tpu.builder.drift_rebuild import drain_drift_queue
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.observability import drift
+    from gordo_tpu.observability import metrics as metric_catalog
+    from gordo_tpu.parallel import BatchedModelBuilder
+    from gordo_tpu.server import hotswap
+    from gordo_tpu.server.server import build_app
+
+    root = tempfile.mkdtemp(prefix="bench-drift-")
+    collection = os.path.join(root, "rev-1")
+    queue_dir = os.path.join(root, "queue")
+    register = os.path.join(root, "register")
+
+    # loop knobs: detector live, small baseline so the synthetic shift
+    # fires fast, queue wired (setdefault: an operator's setting wins)
+    os.environ["GORDO_TPU_DRIFT_DETECT"] = "1"
+    os.environ["GORDO_TPU_DRIFT_QUEUE_DIR"] = queue_dir
+    os.environ.setdefault("GORDO_TPU_DRIFT_MIN_SAMPLES", "16")
+    os.environ.setdefault("GORDO_TPU_DRIFT_THRESHOLD", "4.0")
+
+    machines = [
+        Machine.from_config(
+            _machine_config(f"drift-bench-{i}"), project_name="bench"
+        )
+        for i in range(2)
+    ]
+    # registered builds: the delta rebuild's warm start seeds from these
+    BatchedModelBuilder(
+        machines, output_dir=collection, model_register_dir=register
+    ).build()
+
+    class _Quiet(wsgiref.simple_server.WSGIRequestHandler):
+        def log_message(self, *args):
+            pass
+
+    drift.reset()
+    hotswap.reset_for_tests()
+    app = build_app({"MODEL_COLLECTION_DIR": collection})
+    server = wsgiref.simple_server.make_server(
+        "127.0.0.1", 0, app, handler_class=_Quiet
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    target = machines[1].name  # the machine that drifts and gets swapped
+    n_tags = 4
+    X = [[0.5] * n_tags for _ in range(20)]
+    body = json.dumps({"X": X, "y": X}).encode()
+    drifted_X = [[7.5] * n_tags for _ in range(20)]  # 15x out of range
+    drifted_body = json.dumps({"X": drifted_X, "y": drifted_X}).encode()
+    paths = [
+        f"/gordo/v0/bench/{m.name}/anomaly/prediction" for m in machines
+    ]
+    stop = threading.Event()
+    counts = {"requests": 0, "dropped": 0}
+    revisions: list = []
+    lock = threading.Lock()
+
+    def _pound(tid):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.server_port, timeout=30
+        )
+        i = tid
+        while not stop.is_set():
+            path = paths[i % len(paths)]
+            i += 1
+            try:
+                conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                rev = resp.getheader("revision")
+                with lock:
+                    counts["requests"] += 1
+                    if resp.status >= 300:
+                        counts["dropped"] += 1
+                    elif path == paths[1] and rev and (
+                        not revisions or revisions[-1] != rev
+                    ):
+                        revisions.append(rev)
+            except Exception:  # noqa: BLE001 — a drop is the measurement
+                with lock:
+                    counts["dropped"] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", server.server_port, timeout=30
+                )
+            time.sleep(0.01)
+        conn.close()
+
+    loaders = [
+        threading.Thread(target=_pound, args=(tid,), daemon=True)
+        for tid in range(2)
+    ]
+    warm_starts_before = metric_catalog.WARM_STARTS.value()
+    try:
+        for thread in loaders:
+            thread.start()
+
+        # live traffic seeds both baselines through the serving path (the
+        # views record each request's reconstruction-error stat)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            snap = drift.snapshot()
+            if all(
+                snap.get(m.name, {}).get("status") == "ok"
+                for m in machines
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                f"baselines never froze under live load: {drift.snapshot()}"
+            )
+
+        # drifted sensor feed on the target — same HTTP path the detector
+        # rides, the synthetic stand-in for a sensor going bad under load
+        t_drift = time.time()
+        inject = http.client.HTTPConnection(
+            "127.0.0.1", server.server_port, timeout=30
+        )
+        fired = False
+        for _ in range(200):
+            inject.request(
+                "POST", paths[1], body=drifted_body,
+                headers={"Content-Type": "application/json"},
+            )
+            inject.getresponse().read()
+            if drift.snapshot().get(target, {}).get("status") == "drifted":
+                fired = True
+                break
+        inject.close()
+        if not fired:
+            raise RuntimeError("synthetic drift never fired the detector")
+
+        drained = drain_drift_queue(
+            machines, queue_dir, root, model_register_dir=register
+        )
+        swapped = hotswap.poll_once(collection)
+        detect_to_swap_s = time.time() - t_drift
+        if not swapped:
+            raise RuntimeError(
+                f"hot-swap swapped nothing (drain: {drained})"
+            )
+        time.sleep(0.5)  # post-swap traffic lands on the new revision
+    finally:
+        stop.set()
+        for thread in loaders:
+            thread.join(timeout=10)
+        server.shutdown()
+
+    return {
+        "detect_to_swap_s": round(detect_to_swap_s, 3),
+        "dropped_requests": counts["dropped"],
+        "requests_total": counts["requests"],
+        "swapped_models": len(swapped),
+        "swapped": swapped,
+        "revision": drained.get("revision"),
+        "warm_starts": metric_catalog.WARM_STARTS.value()
+        - warm_starts_before,
+        "revisions_seen": revisions[-4:],
+    }
+
+
 def _section_child(name: str) -> None:
     """Child entrypoint: resolve a backend the same way main() does, run the
     section, print its ``{"platform", "result"}`` envelope as the last
@@ -1721,6 +1911,7 @@ def _section_child(name: str) -> None:
         "windowed": _bench_windowed,
         "batch_ab": _bench_batch_ab,
         "fleet_build": _bench_fleet_build,
+        "drift_loop": _bench_drift_loop,
     }
     result = sections[name]()
     envelope = {"platform": jax.devices()[0].platform, "result": result}
@@ -1816,6 +2007,8 @@ def main():
             enabled.remove("batch_ab")
         if os.environ.get("BENCH_FLEET_BUILD", "1") == "0":
             enabled.remove("fleet_build")
+        if os.environ.get("BENCH_DRIFT_LOOP", "1") == "0":
+            enabled.remove("drift_loop")
 
     # every canonical section appears in the record, disabled ones
     # included — "no section unaccounted for" is the schema's core promise
@@ -1969,6 +2162,7 @@ def _emit_record(sections: dict, recovered: list):
     smoke = sections.get("tpu_smoke") or {}
     serving_load = sections.get("serving_load") or {}
     fleet_build = sections.get("fleet_build") or {}
+    drift_loop = sections.get("drift_loop") or {}
     head = headline.get("result") or {}
 
     serving = head.get("serving", {})
@@ -1987,7 +2181,9 @@ def _emit_record(sections: dict, recovered: list):
     # 'unknown' and break bench_compare's platform matching
     platform = headline.get("platform")
     if not platform:
-        for entry in (smoke, serving_load, windowed, batch_ab, fleet_build):
+        for entry in (
+            smoke, serving_load, windowed, batch_ab, fleet_build, drift_loop,
+        ):
             if entry.get("platform"):
                 platform = entry["platform"]
                 break
@@ -2004,6 +2200,7 @@ def _emit_record(sections: dict, recovered: list):
         "windowed": windowed,
         "batch_ab": batch_ab,
         "fleet_build": fleet_build,
+        "drift_loop": drift_loop,
         "platform": platform,
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
         "sections": {
@@ -2026,6 +2223,7 @@ def _emit_record(sections: dict, recovered: list):
     win = windowed.get("result") or {}
     ab = batch_ab.get("result") or {}
     fb = fleet_build.get("result") or {}
+    dl = drift_loop.get("result") or {}
     smoke_res = smoke.get("result") or {}
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
@@ -2155,6 +2353,20 @@ def _emit_record(sections: dict, recovered: list):
             "elastic_wall_sec": fb.get("elastic_wall_sec"),
             "machines": fb.get("machines"),
             "split_buckets": fb.get("split_buckets"),
+        },
+        # the self-healing drift loop e2e (ISSUE 13): flat keys so
+        # bench_compare.py gates detection-to-swap latency and the
+        # dropped-during-swap count (must hold at 0) like any headline
+        # metric
+        "drift_loop_detect_to_swap_s": dl.get("detect_to_swap_s"),
+        "drift_loop_dropped_requests": dl.get("dropped_requests"),
+        "drift_loop_swapped_models": dl.get("swapped_models"),
+        "drift_loop": {
+            "platform": drift_loop.get("platform"),
+            "requests_total": dl.get("requests_total"),
+            "warm_starts": dl.get("warm_starts"),
+            "revision": dl.get("revision"),
+            "revisions_seen": dl.get("revisions_seen"),
         },
         "detail_file": detail_file,
         # schema v2: every canonical section accounted for with an

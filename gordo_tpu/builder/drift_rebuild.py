@@ -1,0 +1,192 @@
+"""
+Drain the drift-rebuild queue into warm-start delta rebuilds — the
+consumer half of the *trigger* quarter (ISSUE 13).
+
+``gordo drift-rebuilder`` (daemon) and ``gordo batch-build
+--drain-drift-queue`` both call :func:`drain_drift_queue`:
+
+1. claim each pending request through the generation-fenced queue
+   (parallel/drift_queue.py) — two rebuilders watching one queue never
+   build the same machine twice;
+2. **refresh the data window**: each drifted machine's
+   ``train_start/end_date`` slide forward so the window ENDS at the
+   drift detection time while keeping its original length. The full
+   cache key (which includes the dataset config —
+   builder/build_model.calculate_cache_key) therefore misses, while the
+   warm key (config/spec fingerprint only, ``calculate_warm_key``)
+   still hits the registered artifact: exactly the warm-start delta
+   rebuild path, seeded from the drifted model's own params. Keeping
+   the window length bounded matters — "end at wall clock, start where
+   the config said" would quietly grow a 2-day training window into a
+   multi-year fetch;
+3. build ONLY the claimed machines with ``BatchedModelBuilder`` into a
+   fresh **delta revision dir** ``<output root>/drift-<epoch-ms>/``
+   (zero-padded, so lexical order is time order — the hot-swap
+   watcher's fencing relies on it);
+4. commit: write the ``.drift-complete.json`` marker LAST (tmp +
+   ``os.replace``), the atomicity gate serving nodes key on — a
+   revision dir without the marker is invisible, so a rebuilder that
+   dies mid-build leaves garbage but never a half-swapped model;
+5. complete the claims of machines that actually built. A quarantined
+   machine keeps its claim until the stale-claim timeout, after which
+   another drain retries it.
+"""
+
+import json
+import logging
+import os
+import time
+from datetime import datetime, timedelta, timezone
+from typing import Any, Dict, List, Optional
+
+import dateutil.parser
+
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.parallel import drift_queue
+
+logger = logging.getLogger(__name__)
+
+REVISION_PREFIX = "drift-"
+COMPLETE_MARKER = ".drift-complete.json"
+
+
+def revision_name(now: Optional[float] = None) -> str:
+    """``drift-<epoch-ms>``, zero-padded so string order == time order."""
+    millis = int((time.time() if now is None else now) * 1000)
+    return f"{REVISION_PREFIX}{millis:015d}"
+
+
+def _refreshed_machine(machine, request: Dict[str, Any]):
+    """The drifted machine with its training window slid forward to end
+    at the detection timestamp, length preserved. On unparsable dates the
+    config is left untouched (the build then cache-hits and effectively
+    republishes the existing artifact — still safe, just not fresh)."""
+    from gordo_tpu.machine import Machine
+
+    cfg = machine.to_dict()
+    dataset = dict(cfg.get("dataset") or {})
+    try:
+        start = dateutil.parser.isoparse(str(dataset["train_start_date"]))
+        end = dateutil.parser.isoparse(str(dataset["train_end_date"]))
+        detected = float(request.get("detected_at") or time.time())
+        new_end = datetime.fromtimestamp(detected, tz=timezone.utc)
+        if new_end <= end:
+            # replayed/clock-skewed event: still move forward so the full
+            # cache key misses and the rebuild actually retrains
+            new_end = end + timedelta(seconds=1)
+        dataset["train_end_date"] = new_end.isoformat()
+        dataset["train_start_date"] = (new_end - (end - start)).isoformat()
+        cfg["dataset"] = dataset
+    except (KeyError, TypeError, ValueError, OverflowError) as exc:
+        logger.warning(
+            "drift rebuild: could not refresh data window for %s (%s); "
+            "rebuilding with the original window", machine.name, exc,
+        )
+    return Machine.from_dict(cfg)
+
+
+def _write_marker(rev_dir: str, built: List[str], revision: str) -> None:
+    marker = os.path.join(rev_dir, COMPLETE_MARKER)
+    tmp = f"{marker}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(
+            {
+                "revision": revision,
+                "machines": sorted(built),
+                "completed_at": time.time(),
+                "source": "drift-rebuild",
+            },
+            fh,
+        )
+    os.replace(tmp, marker)
+
+
+def drain_drift_queue(
+    machines: List[Any],
+    queue_dir: str,
+    output_root: str,
+    model_register_dir: Optional[str] = None,
+    warm_start: Optional[bool] = None,
+    host_id: Optional[str] = None,
+    serial_fallback: bool = True,
+    fail_fast: bool = False,
+) -> Dict[str, Any]:
+    """One drain pass: claim, rebuild, commit. ``machines`` is the full
+    project fleet (NormalizedConfig.machines); only members with a
+    pending claimed request are built. Returns
+    ``{"revision", "built", "failed", "requests", "skipped"}`` —
+    ``revision`` is None when nothing was claimable."""
+    by_name = {m.name: m for m in machines}
+    requests = drift_queue.pending(queue_dir)
+    claims = []
+    selected = []
+    skipped: List[str] = []
+    for request in requests:
+        name = request.get("machine")
+        machine = by_name.get(name)
+        if machine is None:
+            logger.warning(
+                "drift rebuild: request for %r not in the project config; "
+                "leaving it pending", name,
+            )
+            skipped.append(name)
+            continue
+        handle = drift_queue.claim(queue_dir, name, host_id=host_id)
+        if handle is None:  # another rebuilder holds it
+            skipped.append(name)
+            continue
+        claims.append((handle, request))
+        selected.append(_refreshed_machine(machine, request))
+    if not selected:
+        return {
+            "revision": None, "built": [], "failed": [],
+            "requests": len(requests), "skipped": skipped,
+        }
+
+    from gordo_tpu.parallel import BatchedModelBuilder
+
+    revision = revision_name()
+    rev_dir = os.path.join(output_root, revision)
+    os.makedirs(rev_dir, exist_ok=True)
+    logger.info(
+        "drift rebuild: warm-start rebuilding %s into %s",
+        sorted(m.name for m in selected), rev_dir,
+    )
+    builder = BatchedModelBuilder(
+        selected,
+        serial_fallback=serial_fallback,
+        output_dir=rev_dir,
+        model_register_dir=model_register_dir,
+        fail_fast=fail_fast,
+        warm_start=warm_start,
+    )
+    results = builder.build()
+    built = sorted(machine_out.name for _model, machine_out in results)
+    for name in built:
+        metric_catalog.DRIFT_REBUILDS.labels(model=name).inc()
+    failed = sorted(
+        {handle.machine for handle, _request in claims} - set(built)
+    )
+    if built:
+        _write_marker(rev_dir, built, revision)
+    for handle, request in claims:
+        if handle.machine not in built:
+            # keep the request AND the claim: the stale-claim timeout
+            # fences this generation off and a later drain retries
+            continue
+        drift_queue.complete(
+            queue_dir, handle,
+            {"revision": revision, "detected_at": request.get("detected_at")},
+        )
+    if failed:
+        logger.warning(
+            "drift rebuild: %s failed to build; their requests stay "
+            "queued for retry after the claim timeout", failed,
+        )
+    return {
+        "revision": revision if built else None,
+        "built": built,
+        "failed": failed,
+        "requests": len(requests),
+        "skipped": skipped,
+    }

@@ -363,6 +363,24 @@ def _report_exception_and_exit(
     "to this path — the push-style export for batch jobs scraped via the "
     "node-exporter textfile collector.",
 )
+@click.option(
+    "--drain-drift-queue",
+    is_flag=True,
+    default=False,
+    help="Instead of building the whole config, drain the drift-rebuild "
+    "queue (--drift-queue-dir): claim each pending drift request, "
+    "warm-start rebuild exactly those machines with their data windows "
+    "slid forward to the detection time, and publish them as a delta "
+    "revision dir under --output-dir for serving-side hot swap. See "
+    "docs/components/drift.md",
+)
+@click.option(
+    "--drift-queue-dir",
+    default=None,
+    envvar="GORDO_TPU_DRIFT_QUEUE_DIR",
+    help="The drift-rebuild queue directory serving nodes enqueue into "
+    "(used with --drain-drift-queue)",
+)
 @_reporter_options
 def batch_build(
     config_file: str,
@@ -382,6 +400,8 @@ def batch_build(
     quarantine_report_file: str,
     trace_file: str,
     metrics_file: str,
+    drain_drift_queue: bool,
+    drift_queue_dir: str,
     exceptions_reporter_file: str,
     exceptions_report_level: str,
 ):
@@ -446,6 +466,40 @@ def batch_build(
                     f"--machines names not in config: {sorted(missing)}"
                 )
             selected = [by_name[name] for name in sorted(wanted)]
+        if drain_drift_queue:
+            if not drift_queue_dir:
+                raise click.ClickException(
+                    "--drain-drift-queue needs --drift-queue-dir "
+                    "(or GORDO_TPU_DRIFT_QUEUE_DIR)"
+                )
+            from gordo_tpu.builder import drift_rebuild
+
+            report = drift_rebuild.drain_drift_queue(
+                selected,
+                drift_queue_dir,
+                output_dir,
+                model_register_dir=model_register_dir,
+                warm_start=warm_start,
+                serial_fallback=not no_serial_fallback,
+                fail_fast=fail_fast,
+            )
+            for name in report["built"]:
+                click.echo(
+                    f"drift-rebuilt: {name} -> "
+                    f"{os.path.join(output_dir, report['revision'], name)}"
+                )
+            click.echo(
+                f"drift drain: requests={report['requests']} "
+                f"built={len(report['built'])} "
+                f"failed={len(report['failed'])} "
+                f"skipped={len(report['skipped'])} "
+                f"revision={report['revision']}"
+            )
+            if report["failed"]:
+                sys.exit(
+                    EXIT_PARTIAL if report["built"] else EXIT_NONE_BUILT
+                )
+            return 0
         builder = BatchedModelBuilder(
             selected,
             serial_fallback=not no_serial_fallback,
@@ -620,10 +674,97 @@ def run_gateway_cli(host, port, membership_dir):
     run_gateway(host=host, port=port, directory=membership_dir)
 
 
+@click.command("drift-rebuilder")
+@click.argument(
+    "config-file", type=click.Path(exists=True), envvar="CONFIG_FILE"
+)
+@click.option(
+    "--queue-dir",
+    required=True,
+    envvar="GORDO_TPU_DRIFT_QUEUE_DIR",
+    help="The drift-rebuild queue directory serving nodes enqueue into "
+    "(GORDO_TPU_DRIFT_QUEUE_DIR on the servers)",
+)
+@click.option("--output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option(
+    "--model-register-dir",
+    default=None,
+    envvar="MODEL_REGISTER_DIR",
+    help="Content-hash registry the warm starts seed from; without it the "
+    "delta rebuilds fall back to cold inits",
+)
+@click.option("--project-name", default="batch", envvar="PROJECT_NAME")
+@click.option(
+    "--once",
+    is_flag=True,
+    default=False,
+    help="One drain pass instead of polling forever (cron-style operation)",
+)
+@click.option(
+    "--poll-interval",
+    type=float,
+    default=30.0,
+    envvar="GORDO_TPU_DRIFT_POLL_S",
+    help="Seconds between queue polls in daemon mode",
+)
+def drift_rebuilder(
+    config_file: str,
+    queue_dir: str,
+    output_dir: str,
+    model_register_dir: str,
+    project_name: str,
+    once: bool,
+    poll_interval: float,
+):
+    """Consume the drift-rebuild queue: warm-start delta rebuilds.
+
+    The daemon half of the self-healing loop (docs/components/drift.md):
+    serving nodes detect drift and enqueue rebuild requests
+    (observability/drift.py -> parallel/drift_queue.py); this command
+    claims them through the generation-fenced queue, rebuilds exactly the
+    drifted machines with their training windows slid forward to the
+    detection time, and publishes the result as a ``drift-<epoch-ms>``
+    delta revision dir that serving nodes hot-swap in. Multiple
+    rebuilders may watch one queue: claims are exclusive, stale claims
+    are stolen after the timeout.
+    """
+    import time as _time
+
+    from gordo_tpu.builder import drift_rebuild
+    from gordo_tpu.parallel import drift_queue as _queue
+    from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+    native.prebuild(block=True)
+    from gordo_tpu.util.xla_cache import setup_persistent_xla_cache
+
+    setup_persistent_xla_cache()
+    with open(config_file) as f:
+        config = yaml.safe_load(f)
+    norm = NormalizedConfig(config, project_name=project_name)
+    while True:
+        if _queue.depth(queue_dir):
+            report = drift_rebuild.drain_drift_queue(
+                norm.machines,
+                queue_dir,
+                output_dir,
+                model_register_dir=model_register_dir,
+            )
+            if report["built"] or report["failed"]:
+                click.echo(
+                    f"drift drain: built={report['built']} "
+                    f"failed={report['failed']} "
+                    f"revision={report['revision']}"
+                )
+        if once:
+            return 0
+        _time.sleep(poll_interval)
+
+
 gordo.add_command(build)
 gordo.add_command(batch_build)
 gordo.add_command(run_server_cli)
 gordo.add_command(run_gateway_cli)
+gordo.add_command(drift_rebuilder)
 
 
 def _append_workflow_commands():

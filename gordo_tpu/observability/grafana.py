@@ -1006,6 +1006,126 @@ def gateway_dashboard() -> Dict[str, Any]:
     return _dashboard("Gordo TPU gateway", "gordo-tpu-gateway", panels)
 
 
+def drift_dashboard() -> Dict[str, Any]:
+    """Self-healing drift-loop dashboard (ISSUE 13) over the drift
+    detector, rebuild queue, and hot-swap metrics (observability/drift.py,
+    builder/drift_rebuild.py, server/hotswap.py). These series live in
+    the telemetry registry with a model label and no project label —
+    panels query unselected names like the other telemetry dashboards."""
+    panels = [
+        _timeseries(
+            "Drift events by model",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_drift_events_total"
+                    "[5m])) by (model)",
+                    "legend": "{{model}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            description=(
+                "CUSUM trigger crossings on the serving-path "
+                "reconstruction-error statistic; hysteresis (the "
+                "GORDO_TPU_DRIFT_COOLDOWN_S re-arm) keeps a flapping "
+                "model from storming the rebuild queue"
+            ),
+        ),
+        _timeseries(
+            "Warm-start drift rebuilds by model",
+            [
+                {
+                    "expr": "sum(rate(gordo_build_drift_rebuilds_total"
+                    "[5m])) by (model)",
+                    "legend": "{{model}}",
+                }
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            description=(
+                "Machines rebuilt by the drift-rebuilder into delta "
+                "revision dirs; should track drift events ~1:1 — a gap "
+                "means the queue is backing up or builds are failing"
+            ),
+        ),
+        _timeseries(
+            "Hot swaps & failures",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_hot_swaps_total[5m])) "
+                    "by (model)",
+                    "legend": "swap {{model}}",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_hot_swap_failures_total[5m])) by (model)",
+                    "legend": "FAILED {{model}}",
+                },
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+            description=(
+                "Zero-downtime cutovers on the serving nodes (param-bank "
+                "slot overwrite + revision pointer flip); a failed swap "
+                "leaves the old revision serving and retries next poll"
+            ),
+        ),
+        _timeseries(
+            "Rebuild queue depth & drifted models",
+            [
+                {
+                    "expr": "max(gordo_server_drift_queue_depth)",
+                    "legend": "queue depth",
+                },
+                {
+                    "expr": "max(gordo_server_drifted_models)",
+                    "legend": "drifted models",
+                },
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            description=(
+                "Pending rebuild requests in the drift queue and models "
+                "currently past threshold; both should return to zero "
+                "after the loop closes (rebuild + swap + recalibrate)"
+            ),
+        ),
+        _stat(
+            "Drift events (total)",
+            "sum(gordo_server_drift_events_total)",
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "Drift rebuilds (total)",
+            "sum(gordo_build_drift_rebuilds_total)",
+            panel_id=6,
+            x=6,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "Hot swaps (total)",
+            "sum(gordo_server_hot_swaps_total)",
+            panel_id=7,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+        ),
+        _stat(
+            "Swap failures (total)",
+            "sum(gordo_server_hot_swap_failures_total)",
+            panel_id=8,
+            x=_PANEL_W + 6,
+            y=2 * _PANEL_H,
+        ),
+    ]
+    return _dashboard("Gordo TPU drift loop", "gordo-tpu-drift", panels)
+
+
 def write_dashboards(out_dir: str) -> List[str]:
     """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -1017,6 +1137,7 @@ def write_dashboards(out_dir: str) -> List[str]:
         ("gordo_tpu_resilience.json", resilience_dashboard),
         ("gordo_tpu_fleet.json", fleet_dashboard),
         ("gordo_tpu_gateway.json", gateway_dashboard),
+        ("gordo_tpu_drift.json", drift_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

@@ -418,3 +418,42 @@ GATEWAY_PREWARMS = telemetry.counter(
     "warmed node",
     ("node",),
 )
+
+# -------------------------------------- self-healing drift loop (ISSUE 13)
+# wired by observability/drift.py (detect), parallel/drift_queue.py +
+# builder/drift_rebuild.py (trigger/rebuild), server/hotswap.py (swap)
+DRIFT_EVENTS = telemetry.counter(
+    "gordo_server_drift_events_total",
+    "Drift events emitted by the online detector: a model's reconstruction"
+    "-error CUSUM crossed GORDO_TPU_DRIFT_THRESHOLD (one event per drift "
+    "episode — hysteresis suppresses repeats until rebuild or cooldown)",
+    ("model",),
+)
+DRIFTED_MODELS = telemetry.gauge(
+    "gordo_server_drifted_models",
+    "Models currently in the drifted state on this worker (detected, "
+    "awaiting rebuild + hot-swap)",
+)
+DRIFT_QUEUE_DEPTH = telemetry.gauge(
+    "gordo_server_drift_queue_depth",
+    "Rebuild requests pending in the drift queue dir "
+    "(GORDO_TPU_DRIFT_QUEUE_DIR), sampled on telemetry flushes",
+)
+DRIFT_REBUILDS = telemetry.counter(
+    "gordo_build_drift_rebuilds_total",
+    "Machines rebuilt by the drift rebuilder (warm-start delta rebuilds "
+    "drained from the drift queue into a delta revision dir)",
+    ("model",),
+)
+HOT_SWAPS = telemetry.counter(
+    "gordo_server_hot_swaps_total",
+    "Model revisions hot-swapped into serving with zero downtime (pointer "
+    "flip after preload + warm + in-place param-bank replacement)",
+    ("model",),
+)
+HOT_SWAP_FAILURES = telemetry.counter(
+    "gordo_server_hot_swap_failures_total",
+    "Hot-swap attempts that failed before the pointer flip (the old "
+    "artifact keeps serving; the watcher retries next poll)",
+    ("model",),
+)

@@ -396,6 +396,29 @@ class _ParamBank:
             self._restack(cap)
         return slot
 
+    def replace(self, old_params, new_params) -> Optional[int]:
+        """Overwrite one resident model's slot in place with its rebuilt
+        params (revision hot-swap, ISSUE 13): one on-device
+        ``.at[slot].set``, no restack, no capacity change — so every AOT
+        pre-lowered program (keyed on bank capacity) stays valid and the
+        swap costs zero steady-state trace compiles. Returns the slot, or
+        None when ``old_params`` was never resident (the caller falls
+        back to a plain registration)."""
+        with self._lock:
+            slot = self.slots.pop(id(old_params), None)
+            if slot is None:
+                return None
+            import jax
+
+            self.generation += 1
+            self.trees[slot] = new_params  # drops the old host pytree
+            self.slots[id(new_params)] = slot  # registered as MRU
+            self.stacked = jax.tree_util.tree_map(
+                lambda bank, leaf: bank.at[slot].set(leaf),
+                self.stacked, new_params,
+            )
+            return slot
+
     def _restack(self, cap: int):
         import jax
         import jax.numpy as jnp
@@ -491,6 +514,18 @@ class CrossModelBatcher:
         """Resident models in the spec's bank (0 when no bank exists)."""
         bank = self._banks.get(spec)
         return 0 if bank is None else len(bank)
+
+    def swap_params(self, spec, old_params, new_params) -> bool:
+        """Revision hot-swap (ISSUE 13): replace the old artifact's
+        resident params with the rebuilt ones IN PLACE — the slot, the
+        bank capacity, and therefore every AOT pre-lowered program are
+        all preserved, so the swap is invisible to steady-state latency.
+        False when the old params weren't resident (caller should
+        ``register_params`` the new ones instead)."""
+        bank = self._banks.get(spec)
+        if bank is None:
+            return False
+        return bank.replace(old_params, new_params) is not None
 
     def prelower(
         self,

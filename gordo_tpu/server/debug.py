@@ -23,13 +23,21 @@ nothing new by default):
   and burn rates against the configured objectives
   (observability/slo.py): this process's view always, plus the merged
   fleet view when ``GORDO_TPU_TELEMETRY_DIR`` shards are active.
-- ``POST /debug/prewarm?machine=<name>`` — the one deliberate exception
-  to read-only: run the warmup pre-registration (server/warmup.py —
-  serving-program compiles, param-bank pinning, AOT pre-lowering) for
-  one machine (or the whole collection without ``machine``). The
-  gateway calls this on a draining node's ring successors so the
-  spilled segment lands warm; warming caches is the endpoint's entire
-  point and it mutates nothing else.
+- ``GET /debug/drift`` — the drift detector's per-model state
+  (observability/drift.py): baseline mean/std, CUSUM level, status,
+  rolling-window summary; plus the merged fleet view when telemetry
+  shards are active and the rebuild-queue depth when a drift queue is
+  configured.
+- ``POST /debug/prewarm?machine=<name>[&revision=<rev>]`` — the one
+  deliberate exception to read-only: run the warmup pre-registration
+  (server/warmup.py — serving-program compiles, param-bank pinning, AOT
+  pre-lowering) for one machine (or the whole collection without
+  ``machine``). The gateway calls this on a draining node's ring
+  successors so the spilled segment lands warm, and during a hot-swap
+  cutover with an explicit ``revision=`` so the pre-warm targets the
+  NEW artifact revision rather than whatever warmup last saw (ISSUE
+  13); warming caches is the endpoint's entire point and it mutates
+  nothing else.
 
 Everything else here is read-only: no handler mutates server state (the
 telemetry-shard flush a fleet view triggers only refreshes this
@@ -37,6 +45,7 @@ process's own shard file).
 """
 
 import os
+import re
 from typing import Any, Dict
 
 try:
@@ -80,6 +89,8 @@ def dispatch(endpoint: str, config: Dict[str, Any], request=None) -> Response:
         return vars_view(config)
     if endpoint == "debug_slo":
         return slo_view()
+    if endpoint == "debug_drift":
+        return drift_view()
     if endpoint == "debug_prewarm":
         return prewarm_view(config, request)
     return config_view()
@@ -151,15 +162,62 @@ def slo_view() -> Response:
     return _json(payload)
 
 
+# --------------------------------------------------------------- /debug/drift
+def drift_view() -> Response:
+    """Per-model drift detector state: this process's view always, the
+    merged fleet view when telemetry shards are active, and the rebuild
+    queue depth when a drift queue dir is configured."""
+    from gordo_tpu.observability import drift, shared
+
+    payload: Dict[str, Any] = {
+        "enabled": drift.enabled(),
+        "local": drift.snapshot(),
+        "drifted": drift.drifted_models(),
+    }
+    if shared.enabled():
+        shared.flush(force=True)
+        payload["fleet"] = drift.merge_payloads(shared.fleet_extras("drift"))
+    directory = drift.queue_dir()
+    if directory:
+        from gordo_tpu.parallel import drift_queue
+
+        payload["queue"] = {
+            "dir": directory,
+            "depth": drift_queue.depth(directory),
+            "pending": [r.get("machine") for r in drift_queue.pending(directory)],
+        }
+    return _json(payload)
+
+
 # ------------------------------------------------------------- /debug/prewarm
+# same token shape GordoServer._resolve_revision enforces: a revision is a
+# plain directory name, never a path
+_REVISION_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
 def prewarm_view(config: Dict[str, Any], request=None) -> Response:
     """Warm one machine's (or the whole collection's) serving programs
     through the standard warmup pre-registration — the gateway's
-    successor pre-warm target."""
+    successor pre-warm target. An explicit ``revision=`` warms that
+    sibling revision dir instead of the serving collection (the
+    hot-swap cutover pre-warm, ISSUE 13); an unknown revision is 410
+    like the prediction routes."""
     machine = request.args.get("machine") if request is not None else None
+    revision = request.args.get("revision") if request is not None else None
     collection_dir = config.get("MODEL_COLLECTION_DIR")
     if not collection_dir:
         return _json({"error": "MODEL_COLLECTION_DIR unset"}, status=409)
+    if revision:
+        candidate = os.path.join(collection_dir, "..", revision)
+        if (
+            not _REVISION_RE.match(revision)
+            or ".." in revision
+            or not os.path.isdir(candidate)
+        ):
+            return _json(
+                {"error": f"Revision '{revision}' not found."}, status=410
+            )
+        collection_dir = candidate
     from gordo_tpu.server.warmup import warmup_collection
 
     try:
@@ -168,6 +226,9 @@ def prewarm_view(config: Dict[str, Any], request=None) -> Response:
         )
     except Exception as exc:  # noqa: BLE001 — warming is best-effort
         return _json({"error": str(exc)}, status=500)
+    if revision:
+        result = dict(result)
+        result["revision"] = revision
     return _json(result)
 
 

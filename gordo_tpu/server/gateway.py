@@ -306,6 +306,12 @@ class GatewayServer(EventLoopServer):
         self._state_lock = threading.Lock()
         # machine -> project, LRU-bounded: the prewarm working set
         self._recent: "OrderedDict[str, str]" = OrderedDict()
+        # machine -> last revision a successful upstream response carried
+        # (the `revision` response header every prediction body mirrors):
+        # hot-swap pre-warms target THIS revision explicitly, so a
+        # successor warms the swapped-in artifact, not whatever its boot
+        # warmup last saw (ISSUE 13)
+        self._revisions: "OrderedDict[str, str]" = OrderedDict()
 
         self._cq: Dict[int, _ConnQueue] = {}
         self._jobs: "queue.Queue" = queue.Queue()
@@ -613,6 +619,8 @@ class GatewayServer(EventLoopServer):
                 if name.lower() not in _HOP_BY_HOP
             ]
             out_headers.append(("X-Gordo-Gateway-Node", node.node_id))
+            if machine is not None and status < 300:
+                self._note_revision(machine, up_headers)
             return _serialize(status, out_headers, up_body, keep_alive=keep)
 
         if fallback_response is not None:
@@ -654,6 +662,27 @@ class GatewayServer(EventLoopServer):
             self._recent.move_to_end(machine)
             while len(self._recent) > 4096:
                 self._recent.popitem(last=False)
+
+    def _note_revision(self, machine: str, up_headers) -> None:
+        """Track the revision each machine last answered with (from the
+        upstream ``revision`` response header) so hot-swap pre-warms can
+        name it explicitly."""
+        revision = next(
+            (value for name, value in up_headers
+             if name.lower() == "revision"),
+            None,
+        )
+        if not revision:
+            return
+        with self._state_lock:
+            self._revisions[machine] = revision
+            self._revisions.move_to_end(machine)
+            while len(self._revisions) > 4096:
+                self._revisions.popitem(last=False)
+
+    def _revision_of(self, machine: str) -> Optional[str]:
+        with self._state_lock:
+            return self._revisions.get(machine)
 
     # --------------------------------------------------------- upstream I/O
     _pool = threading.local()
@@ -886,12 +915,19 @@ class GatewayServer(EventLoopServer):
     def _prewarm_one(self, successor: membership.NodeInfo, project: str,
                      machine: str) -> bool:
         timeout = max(0.5, self.connect_timeout_s)
+        target = f"/debug/prewarm?machine={machine}"
+        # name the revision the fleet is currently serving for this
+        # machine (hot-swap cutover: the successor must warm the NEW
+        # artifact, not its boot-time collection)
+        revision = self._revision_of(machine)
+        if revision:
+            target += f"&revision={revision}"
         try:
             conn = http.client.HTTPConnection(
                 successor.host, successor.port, timeout=timeout
             )
             try:
-                conn.request("POST", f"/debug/prewarm?machine={machine}")
+                conn.request("POST", target)
                 resp = conn.getresponse()
                 resp.read()
                 if resp.status == 200:

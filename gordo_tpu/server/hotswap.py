@@ -1,0 +1,269 @@
+"""
+Zero-downtime model hot-swap — the *swap* quarter of the self-healing
+loop (ISSUE 13).
+
+The drift rebuilder (builder/drift_rebuild.py) writes each batch of
+rebuilt machines into a **delta revision dir** next to the serving
+collection dir::
+
+    <root>/
+      rev-abcdef/          <- MODEL_COLLECTION_DIR (full revision)
+      drift-0001754.../    <- delta revision: ONLY the rebuilt machines
+        .drift-complete.json   <- commit marker, written LAST
+        machine-7/ ...
+
+A watcher thread (``GORDO_TPU_HOT_SWAP=1``, polled every
+``GORDO_TPU_HOT_SWAP_POLL_S``) scans for delta revisions whose commit
+marker exists — the marker is the atomicity gate: a revision still being
+built is invisible — and swaps each listed machine in strict order:
+
+1. ``swap_commit`` fault point (chaos hook; a failure aborts THIS swap
+   and the next poll retries — the pointer never flips to a half-loaded
+   model);
+2. preload the new artifact (model + metadata + serving info) into the
+   serving caches;
+3. warm it: ``warmup_collection`` registers params in the batcher's
+   ``_ParamBank`` and AOT pre-lowers the fused programs, and
+   ``CrossModelBatcher.swap_params`` then retires the OLD artifact's
+   bank slot in place (same slot, same capacity — zero steady-state
+   trace compiles after the swap);
+4. flip the per-machine revision pointer (one dict write under a lock):
+   requests resolving AFTER the flip get the new artifact, in-flight
+   requests finish on the old model objects they already hold;
+5. evict the old machine's negative-cache/metadata/serving-info entries
+   (server/utils.evict_machine) and tell the drift detector the loop
+   closed (``drift.note_rebuilt`` — scores recalibrate).
+
+Requests that PIN a revision (``?revision=`` / header) bypass the
+override map entirely: an explicit pin means the client wants exactly
+that artifact (views.py checks ``ctx.revision_pinned``).
+
+Everything is off by default: without ``GORDO_TPU_HOT_SWAP`` no watcher
+starts, and with an empty override map :func:`active` is a single dict
+truthiness check on the request path.
+"""
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from gordo_tpu.observability import drift
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.util import faults
+
+logger = logging.getLogger(__name__)
+
+# a delta revision is committed only once this marker file exists inside
+# it (written atomically, after every artifact is fully on disk)
+COMPLETE_MARKER = ".drift-complete.json"
+REVISION_PREFIX = "drift-"
+
+_lock = threading.Lock()
+# machine name -> (collection dir of the delta revision, revision name)
+_overrides: Dict[str, Tuple[str, str]] = {}
+# machine name -> highest revision name swapped in (lexical fence: delta
+# revision names are zero-padded epoch millis, so string order is time
+# order and a re-scanned old revision can never roll a machine back)
+_last_swapped: Dict[str, str] = {}
+_watcher: Optional[threading.Thread] = None
+_watcher_stop = threading.Event()
+
+
+def enabled() -> bool:
+    return os.environ.get("GORDO_TPU_HOT_SWAP", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+def poll_interval_s() -> float:
+    try:
+        return float(os.environ.get("GORDO_TPU_HOT_SWAP_POLL_S", "5"))
+    except ValueError:
+        return 5.0
+
+
+def active(name: str) -> Optional[Tuple[str, str]]:
+    """The (collection_dir, revision) override for one machine, or None.
+    The no-swap fast path is one truthiness check — no lock taken."""
+    if not _overrides:
+        return None
+    with _lock:
+        return _overrides.get(name)
+
+
+def overrides() -> Dict[str, Tuple[str, str]]:
+    with _lock:
+        return dict(_overrides)
+
+
+# ------------------------------------------------------------------- scan
+def _delta_revisions(collection_dir: str) -> List[Tuple[str, str]]:
+    """Committed delta revisions next to the serving collection dir, as
+    (revision name, path) sorted ascending (oldest first, so a machine
+    rebuilt twice ends on the newest)."""
+    parent = os.path.dirname(os.path.normpath(collection_dir))
+    try:
+        names = sorted(os.listdir(parent))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.startswith(REVISION_PREFIX):
+            continue
+        path = os.path.join(parent, name)
+        if os.path.isdir(path) and os.path.exists(
+            os.path.join(path, COMPLETE_MARKER)
+        ):
+            out.append((name, path))
+    return out
+
+
+def _marker_machines(rev_dir: str) -> List[str]:
+    try:
+        with open(os.path.join(rev_dir, COMPLETE_MARKER)) as fh:
+            body = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    machines = body.get("machines") if isinstance(body, dict) else None
+    return [m for m in machines or [] if isinstance(m, str)]
+
+
+def poll_once(collection_dir: str) -> List[str]:
+    """One watcher tick: find committed delta revisions and swap every
+    machine that is newer than what this process last swapped in.
+    Returns the machine names swapped this tick."""
+    swapped: List[str] = []
+    for revision, rev_dir in _delta_revisions(collection_dir):
+        for machine in _marker_machines(rev_dir):
+            if _last_swapped.get(machine, "") >= revision:
+                continue
+            if _swap_one(collection_dir, rev_dir, revision, machine):
+                swapped.append(machine)
+    return swapped
+
+
+# ------------------------------------------------------------------- swap
+def _swap_one(
+    base_dir: str, rev_dir: str, revision: str, machine: str
+) -> bool:
+    from gordo_tpu.server import utils as server_utils
+
+    # where is the machine CURRENTLY served from? (a prior delta revision
+    # may already override it)
+    current = active(machine)
+    old_dir = current[0] if current else base_dir
+    try:
+        faults.fault_point("swap_commit", machine=machine)
+        # everything below happens BEFORE the pointer flips: a failure
+        # leaves the old artifact serving, untouched
+        new_model = server_utils.load_model(rev_dir, machine)
+        server_utils.load_metadata(rev_dir, machine)
+        server_utils.load_serving_info(rev_dir, machine)
+        _warm(rev_dir, machine)
+        _swap_bank(old_dir, machine, new_model)
+        with _lock:
+            _overrides[machine] = (rev_dir, revision)
+            _last_swapped[machine] = revision
+        # after the flip: clear caches that still describe the OLD
+        # artifact (incl. any negative entry masking the new one), and
+        # close the detection loop so scores recalibrate
+        server_utils.evict_machine(machine, keep_dir=rev_dir)
+        drift.note_rebuilt(machine)
+        metric_catalog.HOT_SWAPS.labels(model=machine).inc()
+        logger.info(
+            "hot-swap: %s now serving revision %s", machine, revision
+        )
+        return True
+    except Exception as exc:  # noqa: BLE001 — next poll retries
+        metric_catalog.HOT_SWAP_FAILURES.labels(model=machine).inc()
+        logger.warning(
+            "hot-swap of %s to revision %s failed (old artifact keeps "
+            "serving): %s", machine, revision, exc,
+        )
+        return False
+
+
+def _warm(rev_dir: str, machine: str) -> None:
+    """Pre-warm the new artifact exactly like boot warmup would — predict
+    program compiles, param-bank registration, AOT pre-lowering — so the
+    first post-swap request pays nothing. Best-effort by design."""
+    from gordo_tpu.server.warmup import warmup_collection
+
+    warmup_collection(rev_dir, names=[machine])
+
+
+def _swap_bank(old_dir: str, machine: str, new_model) -> None:
+    """Retire the old artifact's param-bank slots in place. Only possible
+    when the old model object is still cached (it holds the params the
+    bank keys on); otherwise the old slots age out via LRU and the new
+    params were already registered by the warmup above."""
+    from gordo_tpu.server.batcher import peek_batcher
+    from gordo_tpu.server.utils import peek_model
+    from gordo_tpu.server.warmup import _jax_estimators
+
+    batcher = peek_batcher()
+    if batcher is None:
+        return
+    old_model = peek_model(old_dir, machine)
+    if old_model is None:
+        return
+    new_by_spec = {
+        est.spec_: est.params_ for est in _jax_estimators(new_model)
+    }
+    for old_est in _jax_estimators(old_model):
+        new_params = new_by_spec.get(old_est.spec_)
+        if new_params is None:
+            continue
+        try:
+            batcher.swap_params(old_est.spec_, old_est.params_, new_params)
+        except Exception as exc:  # noqa: BLE001 — LRU ages the slot out
+            logger.warning(
+                "param-bank swap for %s failed (slot will LRU out): %s",
+                machine, exc,
+            )
+
+
+# ---------------------------------------------------------------- watcher
+def start_watcher(collection_dir: str) -> Optional[threading.Thread]:
+    """Start the daemon poll thread (idempotent; None when the
+    ``GORDO_TPU_HOT_SWAP`` gate is closed)."""
+    global _watcher
+    if not enabled():
+        return None
+    if _watcher is not None and _watcher.is_alive():
+        return _watcher
+    _watcher_stop.clear()
+
+    def _loop():
+        while not _watcher_stop.wait(poll_interval_s()):
+            try:
+                poll_once(collection_dir)
+            except Exception as exc:  # noqa: BLE001 — watcher must survive
+                logger.warning("hot-swap watcher tick failed: %s", exc)
+
+    _watcher = threading.Thread(
+        target=_loop, name="gordo-hotswap-watcher", daemon=True
+    )
+    _watcher.start()
+    logger.info(
+        "hot-swap watcher started (poll every %.1fs) over %s",
+        poll_interval_s(), collection_dir,
+    )
+    return _watcher
+
+
+def stop_watcher() -> None:
+    global _watcher
+    _watcher_stop.set()
+    if _watcher is not None:
+        _watcher.join(timeout=2.0)
+    _watcher = None
+
+
+def reset_for_tests() -> None:
+    stop_watcher()
+    with _lock:
+        _overrides.clear()
+        _last_swapped.clear()

@@ -261,12 +261,27 @@ def openapi_document() -> dict:
                     },
                 }
             },
+            "/debug/drift": {
+                "get": {
+                    "summary": "Per-model drift-detector state — baseline, "
+                    "CUSUM score, status, rolling error windows — local "
+                    "and fleet-merged, plus rebuild-queue depth; gated by "
+                    "GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "{enabled, local, fleet, "
+                                "queue}"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
             "/debug/prewarm": {
                 "post": {
                     "summary": "Warm the serving caches for one machine "
-                    "(?machine=<name>) or the whole collection: program "
-                    "compile, param-bank pin, AOT pre-lower — the "
-                    "gateway's successor pre-warm hook; gated by "
+                    "(?machine=<name>) or the whole collection — "
+                    "optionally a specific revision (&revision=<rev>, the "
+                    "hot-swap cutover pre-warm): program compile, "
+                    "param-bank pin, AOT pre-lower — the gateway's "
+                    "successor pre-warm hook; gated by "
                     "GORDO_TPU_DEBUG_ENDPOINTS",
                     "responses": {
                         "200": {"description": "Warmup summary JSON"},

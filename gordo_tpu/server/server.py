@@ -32,6 +32,7 @@ from werkzeug.wrappers import Request, Response
 
 from gordo_tpu import __version__
 from gordo_tpu.observability import (
+    drift,
     flight,
     metrics as metric_catalog,
     shared,
@@ -159,6 +160,9 @@ class RequestContext:
         self.collection_dir: Optional[str] = None
         self.current_revision: Optional[str] = None
         self.revision: Optional[str] = None
+        # True when the client named a revision explicitly (?revision= or
+        # header): the hot-swap override map must not redirect a pin
+        self.revision_pinned: bool = False
         # per-phase durations (seconds) recorded by the view handlers via
         # phase(); rendered into the response's Server-Timing header
         self.timings: Dict[str, float] = {}
@@ -193,6 +197,7 @@ class GordoServer:
             Rule("/debug/vars", endpoint="debug_vars"),
             Rule("/debug/config", endpoint="debug_config"),
             Rule("/debug/slo", endpoint="debug_slo"),
+            Rule("/debug/drift", endpoint="debug_drift"),
             Rule("/debug/prewarm", endpoint="debug_prewarm"),
             Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
             Rule(
@@ -250,6 +255,9 @@ class GordoServer:
         from gordo_tpu.observability import device as device_telemetry
 
         device_telemetry.install_shard_hooks()
+        # drift detector windows ride the same shard flushes (no-op until
+        # GORDO_TPU_DRIFT_DETECT records anything)
+        drift.install_shard_hooks()
         self._prometheus = None
         if self.config["ENABLE_PROMETHEUS"]:
             from gordo_tpu.server.prometheus.metrics import (
@@ -280,6 +288,7 @@ class GordoServer:
         ctx.current_revision = os.path.basename(os.path.normpath(collection_dir or ""))
         revision = request.args.get("revision") or request.headers.get("revision")
         if revision:
+            ctx.revision_pinned = True
             candidate = os.path.join(collection_dir, "..", revision)
             if (
                 not self._REVISION_RE.match(revision)
@@ -639,6 +648,22 @@ def build_app(
     # instance attribute shadows the bound method, exactly like the
     # reference's ``app.wsgi_app = adapt_proxy_deployment(app.wsgi_app)``
     app.wsgi_app = adapt_proxy_deployment(app.wsgi_app)
+    # revision hot-swap watcher (server/hotswap.py): a daemon thread per
+    # serving process, polling for committed delta revisions. Gated on
+    # GORDO_TPU_HOT_SWAP — without it this is a single env read.
+    from gordo_tpu.server import hotswap
+
+    if hotswap.enabled():
+        collection_dir = app.config.get("MODEL_COLLECTION_DIR") or os.environ.get(
+            "MODEL_COLLECTION_DIR", ""
+        )
+        if collection_dir:
+            hotswap.start_watcher(collection_dir)
+        else:
+            logger.warning(
+                "GORDO_TPU_HOT_SWAP set but MODEL_COLLECTION_DIR unset; "
+                "no hot-swap watcher started"
+            )
     return app
 
 

@@ -251,10 +251,70 @@ def _clear_model_cache():
 load_model.cache_clear = _clear_model_cache
 
 
-@lru_cache(maxsize=25000)
+def peek_model(directory: str, name: str):
+    """The cached model object for ``(directory, name)`` or None — never
+    loads. The hot-swap path uses this to find the OLD artifact's params
+    for in-place param-bank replacement without re-deserializing a model
+    that was never served."""
+    with _cache_lock:
+        return _model_cache.get((directory, name))
+
+
+class _KeyedLru:
+    """An ``lru_cache``-shaped cache keyed on ``(directory, name)`` that
+    additionally supports per-machine eviction. functools.lru_cache can
+    only be cleared wholesale — a hot-swap that nuked EVERY machine's
+    metadata to refresh one would make a 5000-model fleet re-read 5000
+    pickles under live traffic (ISSUE 13 satellite)."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._data: "OrderedDict[Tuple[str, str], object]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_or_load(self, key: Tuple[str, str], loader):
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                return self._data[key]
+        # load outside the lock: metadata reads are cheap and concurrent
+        # first-loads for one key are idempotent (last writer wins)
+        value = loader()
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
+        return value
+
+    def evict_name(self, name: str, keep_dir: str = None) -> int:
+        with self._lock:
+            doomed = [
+                key for key in self._data
+                if key[1] == name and key[0] != keep_dir
+            ]
+            for key in doomed:
+                del self._data[key]
+        return len(doomed)
+
+    def cache_clear(self):
+        with self._lock:
+            self._data.clear()
+
+
+_metadata_cache = _KeyedLru(maxsize=25000)
+_serving_info_cache = _KeyedLru(maxsize=4096)
+
+
 def _load_compressed_metadata(directory: str, name: str) -> bytes:
-    metadata = serializer.load_metadata(os.path.join(directory, name))
-    return zlib.compress(pickle.dumps(metadata))
+    def _loader() -> bytes:
+        metadata = serializer.load_metadata(os.path.join(directory, name))
+        return zlib.compress(pickle.dumps(metadata))
+
+    return _metadata_cache.get_or_load((directory, name), _loader)
+
+
+_load_compressed_metadata.cache_clear = _metadata_cache.cache_clear
 
 
 def load_metadata(directory: str, name: str) -> dict:
@@ -262,7 +322,6 @@ def load_metadata(directory: str, name: str) -> dict:
     return pickle.loads(zlib.decompress(_load_compressed_metadata(directory, name)))
 
 
-@lru_cache(maxsize=4096)
 def load_serving_info(directory: str, name: str):
     """``(tags, target_tags, frequency)`` for one artifact, cached.
 
@@ -273,18 +332,54 @@ def load_serving_info(directory: str, name: str):
     p50). Artifacts are immutable per (directory, name), so the derived
     tuple caches safely; memory is three small tuples per model against
     the compressed blob already held."""
-    from gordo_tpu.dataset.sensor_tag import normalize_sensor_tags
 
-    dataset_meta = load_metadata(directory, name)["dataset"]
-    asset = dataset_meta.get("asset")
-    tag_list = dataset_meta.get("tag_list") or dataset_meta.get("tags") or []
-    tags = tuple(normalize_sensor_tags(tag_list, asset=asset))
-    target = dataset_meta.get("target_tag_list")
-    target_tags = tuple(normalize_sensor_tags(target, asset=asset)) if target else tags
-    frequency = pd.tseries.frequencies.to_offset(
-        dataset_meta.get("resolution", "10min")
-    )
-    return tags, target_tags, frequency
+    def _loader():
+        from gordo_tpu.dataset.sensor_tag import normalize_sensor_tags
+
+        dataset_meta = load_metadata(directory, name)["dataset"]
+        asset = dataset_meta.get("asset")
+        tag_list = dataset_meta.get("tag_list") or dataset_meta.get("tags") or []
+        tags = tuple(normalize_sensor_tags(tag_list, asset=asset))
+        target = dataset_meta.get("target_tag_list")
+        target_tags = (
+            tuple(normalize_sensor_tags(target, asset=asset)) if target else tags
+        )
+        frequency = pd.tseries.frequencies.to_offset(
+            dataset_meta.get("resolution", "10min")
+        )
+        return tags, target_tags, frequency
+
+    return _serving_info_cache.get_or_load((directory, name), _loader)
+
+
+load_serving_info.cache_clear = _serving_info_cache.cache_clear
+
+
+def evict_machine(name: str, keep_dir: str = None) -> None:
+    """Per-machine cache eviction for revision hot-swap (ISSUE 13).
+
+    Clears everything that could mask or misdescribe a freshly-landed
+    artifact revision of ``name``:
+
+    - the TTL'd negative cache — a failed load cached up to
+      ``GORDO_TPU_LOAD_FAILURE_TTL_S`` ago must not shadow the rebuilt
+      artifact (cleared for ALL directories, including ``keep_dir``);
+    - cached metadata and derived serving info (tags/frequency) — stale
+      entries would survive the swap and describe the old artifact;
+    - cached model objects for superseded directories.
+
+    ``keep_dir`` protects the NEW revision's freshly-preloaded positive
+    entries; in-flight requests keep serving off the old model objects
+    they already hold references to."""
+    with _cache_lock:
+        for key in [k for k in _failed_loads if k[1] == name]:
+            del _failed_loads[key]
+        for key in [
+            k for k in _model_cache if k[1] == name and k[0] != keep_dir
+        ]:
+            del _model_cache[key]
+    _metadata_cache.evict_name(name, keep_dir=keep_dir)
+    _serving_info_cache.evict_name(name, keep_dir=keep_dir)
 
 
 def clear_model_caches():

@@ -31,8 +31,9 @@ from werkzeug.wrappers import Response
 
 from gordo_tpu import __version__, serializer
 from gordo_tpu.models import utils as model_utils
+from gordo_tpu.observability import drift
 from gordo_tpu.observability import metrics as metric_catalog
-from gordo_tpu.server import fast_codec, model_io
+from gordo_tpu.server import fast_codec, hotswap, model_io
 from gordo_tpu.server import resilience
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.util import faults
@@ -175,6 +176,16 @@ class ModelContext:
     def __init__(self, ctx, gordo_name: str):
         self.ctx = ctx
         self.gordo_name = gordo_name
+        # revision hot-swap (server/hotswap.py): resolve the effective
+        # collection dir ONCE per request — in-flight requests finish on
+        # whatever they resolved, a flip mid-request can't mix revisions.
+        # Clients that pinned ?revision=/header bypass the override; the
+        # no-swap fast path is a single empty-dict truthiness check.
+        self.collection_dir = ctx.collection_dir
+        if not getattr(ctx, "revision_pinned", False):
+            override = hotswap.active(gordo_name)
+            if override is not None:
+                self.collection_dir, ctx.revision = override
         self._model = None
         self._metadata = None
         self._serving_info = None
@@ -184,7 +195,7 @@ class ModelContext:
         if self._model is None:
             try:
                 self._model = server_utils.load_model(
-                    self.ctx.collection_dir, self.gordo_name
+                    self.collection_dir, self.gordo_name
                 )
             except FileNotFoundError:
                 raise NotFound(f"No such model found: '{self.gordo_name}'")
@@ -195,7 +206,7 @@ class ModelContext:
         if self._metadata is None:
             try:
                 self._metadata = server_utils.load_metadata(
-                    self.ctx.collection_dir, self.gordo_name
+                    self.collection_dir, self.gordo_name
                 )
             except FileNotFoundError:
                 raise NotFound(f"No model found for '{self.gordo_name}'")
@@ -208,7 +219,7 @@ class ModelContext:
         if self._serving_info is None:
             try:
                 self._serving_info = server_utils.load_serving_info(
-                    self.ctx.collection_dir, self.gordo_name
+                    self.collection_dir, self.gordo_name
                 )
             except FileNotFoundError:
                 raise NotFound(f"No model found for '{self.gordo_name}'")
@@ -281,6 +292,66 @@ def extract_X_y(request, mc: ModelContext):
     if y is not None:
         y = server_utils.verify_dataframe(y, [t.name for t in mc.target_tags])
     return X, y
+
+
+# ------------------------------------------------------- drift statistics
+def _record_drift_stat(gordo_name: str, stat_fn) -> None:
+    """Feed one reconstruction-error observation to the drift detector
+    (observability/drift.py). Computed ONLY when the detector gate is
+    open — with ``GORDO_TPU_DRIFT_DETECT`` unset the serving path does
+    no extra work — and never allowed to fail the request."""
+    if not drift.enabled():
+        return
+    try:
+        stat = stat_fn()
+        if stat is not None:
+            drift.observe(gordo_name, float(stat))
+    except Exception:  # noqa: BLE001 — detection is advisory
+        logger.debug(
+            "drift stat computation failed for %r", gordo_name, exc_info=True
+        )
+
+
+def _base_reconstruction_stat(mc: "ModelContext", X, output):
+    """Mean absolute reconstruction error of a base predict: |output −
+    target slice of the input|, offset-aligned for windowed models. When
+    the output doesn't map onto input columns (transform-only models),
+    falls back to mean |output| — any stable per-request scalar supports
+    shift detection."""
+    out = np.asarray(output, dtype=float)
+    if out.ndim != 2 or out.size == 0:
+        return None
+    X_vals = X.values if isinstance(X, pd.DataFrame) else np.asarray(X)
+    offset = len(X_vals) - len(out)
+    if offset >= 0 and out.shape[1] == len(mc.target_tags):
+        tag_names = [t.name for t in mc.tags]
+        try:
+            cols = [tag_names.index(t.name) for t in mc.target_tags]
+        except ValueError:
+            cols = None
+        if cols is not None:
+            target = np.asarray(X_vals, dtype=float)[offset:, cols]
+            if target.shape == out.shape:
+                return float(np.nanmean(np.abs(out - target)))
+    return float(np.nanmean(np.abs(out)))
+
+
+def _anomaly_total_stat(anomaly_df):
+    """The mean of the anomaly frame's ``total-anomaly-unscaled`` block —
+    the calibrated per-point reconstruction error every diff-based
+    detector emits (models/anomaly/diff.py), off either the unassembled
+    RawFrame or an assembled MultiIndex frame."""
+    groups = getattr(anomaly_df, "groups", None)
+    if groups is not None:
+        for top, _subs, values in groups:
+            if top == "total-anomaly-unscaled":
+                return float(np.nanmean(np.asarray(values, dtype=float)))
+        return None
+    try:
+        block = anomaly_df["total-anomaly-unscaled"]
+    except (KeyError, TypeError, IndexError):
+        return None
+    return float(np.nanmean(np.asarray(block, dtype=float)))
 
 
 # ------------------------------------------------------------------- routes
@@ -365,6 +436,9 @@ def base_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
         context["error"] = "Something unexpected happened; check your input data"
         return json_body(ctx, context, 400)
     resilience.record_breaker_success(breaker)
+    _record_drift_stat(
+        gordo_name, lambda: _base_reconstruction_stat(mc, X, output)
+    )
 
     with ctx.phase("encode"):
         data = model_utils.make_base_raw(
@@ -457,6 +531,8 @@ def anomaly_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
         resilience.record_breaker_failure(breaker, exc)
         raise
     resilience.record_breaker_success(breaker)
+    # before the encode phase mutates/drops columns off the frame
+    _record_drift_stat(gordo_name, lambda: _anomaly_total_stat(anomaly_df))
 
     with ctx.phase("encode"):
         is_raw = isinstance(anomaly_df, model_utils.RawFrame)

@@ -84,6 +84,17 @@ inside a serving node's membership heartbeat (machine = node id) — any
 injected error stops the heartbeat and runs the registration's
 ``on_dead`` callback, the in-process stand-in for kill -9 (the lease
 goes stale and the gateway spills the node's ring segment).
+
+Drift-loop sites (ISSUE 13, observability/drift.py + parallel/drift_queue.py
++ server/hotswap.py): ``drift_detect`` fires when the detector is about to
+emit a drift event (machine = the drifted model) — inject a transient to
+check a failed emit neither crashes the serving path nor loses the CUSUM
+state; ``drift_enqueue`` fires at the top of the rebuild-queue enqueue
+(machine = the drifted model) — an injected error means the request file
+is never created, exercising the next detection window's retry;
+``swap_commit`` fires at the start of a hot-swap cutover (machine = the
+model being swapped) — an injected error leaves the OLD revision serving
+untouched and the next watcher poll retries the swap.
 """
 
 import json

@@ -87,6 +87,13 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     ("fleet_build_machines_per_sec", True),
     ("fleet_build_compile_seconds_saved", True),
     ("fleet_build_steals_total", True),
+    # self-healing drift loop e2e (ISSUE 13): how fast a detected drift
+    # becomes a hot-swapped rebuilt model, and how many requests the swap
+    # dropped — the latter is 0 by construction, so ANY increase is a
+    # regression (0-to-nonzero is caught by the old-value-0 skip note plus
+    # the detect_to_swap gate; a nonzero baseline gates normally)
+    ("drift_loop_detect_to_swap_s", False),
+    ("drift_loop_dropped_requests", False),
 )
 
 # which harness section feeds each metric (schema v2 records carry a
@@ -106,6 +113,8 @@ def metric_section(key: str, parsed: dict) -> Optional[str]:
         return "serving_load"
     if key.startswith("fleet_build_"):
         return "fleet_build"
+    if key.startswith("drift_loop_"):
+        return "drift_loop"
     return None
 
 

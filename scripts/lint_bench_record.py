@@ -64,6 +64,11 @@ _NUMERIC_KEYS = (
     # the elastic fleet-build scheduler's A/B section (ISSUE 10)
     "fleet_build_machines_per_sec", "fleet_build_compile_seconds_saved",
     "fleet_build_steals_total",
+    # the self-healing drift loop e2e section (ISSUE 13):
+    # detection-to-swap latency, requests dropped during the swap window
+    # (the zero-downtime claim, gated at 0-regression), models swapped
+    "drift_loop_detect_to_swap_s", "drift_loop_dropped_requests",
+    "drift_loop_swapped_models",
 )
 
 
@@ -73,6 +78,8 @@ _FALLBACK_NAMES_BY_VERSION = {
     2: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"],
     3: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build"],
+    4: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build", "drift_loop"],
 }
 _FALLBACK_STATUSES = [
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",

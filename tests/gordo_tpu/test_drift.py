@@ -1,0 +1,427 @@
+"""
+The self-healing drift loop's pieces in isolation (ISSUE 13): the CUSUM
+detector (observability/drift.py), the filesystem-lease rebuild queue
+(parallel/drift_queue.py), the hot-swap watcher's scan/fencing logic
+(server/hotswap.py), the per-machine serving-cache eviction
+(server/utils.py), and the shard-death merge invariant shared with the
+SLO windows. The end-to-end chaos drive lives in test_drift_loop.py.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from gordo_tpu.observability import drift, shared, slo, telemetry
+from gordo_tpu.parallel import drift_queue
+from gordo_tpu.server import hotswap
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.util import faults
+
+
+@pytest.fixture(autouse=True)
+def _detector_on(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_DRIFT_DETECT", "1")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_MIN_SAMPLES", "5")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_THRESHOLD", "4.0")
+    monkeypatch.delenv("GORDO_TPU_DRIFT_QUEUE_DIR", raising=False)
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    faults.reset_plan()
+    drift.reset()
+    hotswap.reset_for_tests()
+    yield
+    drift.reset()
+    hotswap.reset_for_tests()
+    faults.reset_plan()
+
+
+def _seed_baseline(model, n=5, value=1.0, t0=1_000_000.0):
+    """Alternating values around ``value`` so the frozen baseline has a
+    real (nonzero) standard deviation."""
+    for i in range(n):
+        drift.observe(model, value + (0.1 if i % 2 else -0.1), now=t0 + i)
+    return t0 + n
+
+
+# ----------------------------------------------------------- the detector
+def test_gate_closed_records_nothing(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_DRIFT_DETECT")
+    assert not drift.observe("m", 1.0)
+    assert drift.snapshot() == {}
+
+
+def test_baseline_freezes_then_cusum_fires_once():
+    t = _seed_baseline("m")
+    state = drift.snapshot()["m"]
+    assert state["status"] == "ok"
+    assert state["baseline_n"] == 5
+    assert state["baseline_std"] > 0
+
+    fired = []
+    for i in range(10):
+        if drift.observe("m", 50.0, now=t + i):
+            fired.append(i)
+            break
+    assert fired, "a 50x shift never tripped the detector"
+    snap = drift.snapshot()["m"]
+    assert snap["status"] == "drifted"
+    assert snap["events"] == 1
+
+
+def test_normal_traffic_never_fires():
+    t = _seed_baseline("m")
+    for i in range(500):
+        assert not drift.observe("m", 1.0 + (0.1 if i % 2 else -0.1),
+                                 now=t + i)
+    assert drift.snapshot()["m"]["status"] == "ok"
+
+
+def test_hysteresis_cooldown_then_rearm(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_DRIFT_COOLDOWN_S", "100")
+    t = _seed_baseline("m")
+    while not drift.observe("m", 50.0, now=t):
+        t += 1
+    assert drift.snapshot()["m"]["events"] == 1
+    # within the cooldown the same shift stays silent
+    for i in range(20):
+        assert not drift.observe("m", 50.0, now=t + i)
+    assert drift.snapshot()["m"]["events"] == 1
+    # past the cooldown the alarm re-arms and a persistent shift fires a
+    # SECOND event (still drifting, never rebuilt -> page again)
+    t2 = t + 200
+    fired = False
+    for i in range(10):
+        if drift.observe("m", 50.0, now=t2 + i):
+            fired = True
+            break
+    assert fired
+    assert drift.snapshot()["m"]["events"] == 2
+
+
+def test_note_rebuilt_recalibrates():
+    t = _seed_baseline("m", value=1.0)
+    while not drift.observe("m", 50.0, now=t):
+        t += 1
+    drift.note_rebuilt("m")
+    snap = drift.snapshot()["m"]
+    assert snap["status"] == "baseline"
+    assert snap["baseline_n"] == 0
+    # the rebuilt model's scores settle at a NEW normal: the old 1.0
+    # baseline is gone and 10.0-centered traffic is now "ok", not drift
+    t = _seed_baseline("m", value=10.0, t0=t + 10)
+    for i in range(50):
+        assert not drift.observe("m", 10.0 + (0.1 if i % 2 else -0.1),
+                                 now=t + i)
+    snap = drift.snapshot()["m"]
+    assert snap["status"] == "ok"
+    assert abs(snap["baseline_mean"] - 10.0) < 0.2
+
+
+def test_non_finite_values_ignored():
+    assert not drift.observe("m", float("nan"))
+    assert not drift.observe("m", float("inf"))
+    assert drift.snapshot() == {}
+
+
+def test_rolling_windows_expire(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_DRIFT_WINDOW_S", "600")  # 2 sub-windows
+    drift.observe("m", 1.0, now=0.0)
+    drift.observe("m", 1.0, now=1.0)
+    assert drift.snapshot()["m"]["recent_count"] == 2
+    # 3 sub-window widths later the old bucket has aged out
+    drift.observe("m", 2.0, now=3 * drift._SUBWINDOW_S + 1.0)
+    snap = drift.snapshot()["m"]
+    assert snap["recent_count"] == 1
+    assert snap["recent_mean"] == 2.0
+
+
+def test_event_emission_enqueues_once(tmp_path, monkeypatch):
+    queue = str(tmp_path / "q")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_QUEUE_DIR", queue)
+    t = _seed_baseline("m")
+    while not drift.observe("m", 50.0, now=t):
+        t += 1
+    pending = drift_queue.pending(queue)
+    assert [r["machine"] for r in pending] == ["m"]
+    assert pending[0]["baseline_mean"] == pytest.approx(1.0, abs=0.1)
+    assert pending[0]["detected_at"] == t
+
+
+def test_injected_enqueue_fault_never_fails_the_observation(
+    tmp_path, monkeypatch
+):
+    queue = str(tmp_path / "q")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_QUEUE_DIR", queue)
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        json.dumps({"rules": [{"site": "drift_enqueue", "machine": "m",
+                               "times": -1, "error": "permanent"}]}),
+    )
+    faults.reset_plan()
+    t = _seed_baseline("m")
+    fired = False
+    for i in range(10):
+        if drift.observe("m", 50.0, now=t + i):  # must not raise
+            fired = True
+            break
+    assert fired
+    assert drift_queue.depth(queue) == 0  # the enqueue itself was eaten
+
+
+def test_cardinality_overflow_collapses():
+    for i in range(drift._MAX_MODELS):
+        drift.observe(f"m-{i}", 1.0, now=0.0)
+    drift.observe("one-too-many", 1.0, now=0.0)
+    snap = drift.snapshot()
+    assert "one-too-many" not in snap
+    assert drift._OVERFLOW in snap
+
+
+# ------------------------------------------------------------- fleet merge
+def test_merge_payloads_matches_single_stream(monkeypatch):
+    # one worker sees the first half of a stream, another the second;
+    # the merged baseline must equal the single-process computation
+    # (min_samples high so every stream stays in its baseline arm)
+    monkeypatch.setenv("GORDO_TPU_DRIFT_MIN_SAMPLES", "100")
+    values = [1.0, 1.2, 0.8, 1.1, 0.9, 1.05, 0.95, 1.15]
+    for v in values[:4]:
+        drift.observe("m", v, now=100.0)
+    shard_a = drift.shard_payload()
+    drift.reset()
+    for v in values[4:]:
+        drift.observe("m", v, now=100.0)
+    shard_b = drift.shard_payload()
+    drift.reset()
+    for v in values:
+        drift.observe("m", v, now=100.0)
+    reference = drift.shard_payload()["m"]["baseline"]
+
+    merged = drift.merge_payloads([(1, shard_a), (2, shard_b)])["m"]
+    assert merged["baseline"][0] == reference[0]
+    assert merged["baseline"][1] == pytest.approx(reference[1])
+    assert merged["baseline"][2] == pytest.approx(reference[2])
+    assert merged["recent_count"] == len(values)
+    assert merged["recent_mean"] == pytest.approx(sum(values) / len(values))
+
+
+def test_merge_counts_drifted_workers():
+    t = _seed_baseline("m")
+    while not drift.observe("m", 50.0, now=t):
+        t += 1
+    shard = drift.shard_payload()
+    merged = drift.merge_payloads([(1, shard), (2, {"m": {
+        "windows": {}, "baseline": [0, 0.0, 0.0], "events": 0,
+        "status": "ok"}})])
+    assert merged["m"]["drifted_workers"] == 1
+    assert merged["m"]["events"] == 1
+
+
+def _write_fake_shard(pid: int, extras: dict) -> None:
+    payload = json.dumps({
+        "schema": shared.PAYLOAD_SCHEMA, "pid": pid, "metrics": [],
+        "extras": extras,
+    }).encode()
+    writer = shared._ShardWriter(shared.shard_path(pid))
+    writer.write(payload)
+    writer.close()
+
+
+def test_shard_death_drops_rows_without_zero_or_double_count(
+    tmp_path, monkeypatch
+):
+    """Satellite 3: reaping a worker mid-detection removes exactly that
+    worker's contribution from the fleet-merged drift AND slo windows —
+    the survivor's rolling windows are neither zeroed nor double-counted."""
+    monkeypatch.setenv(shared.ENV_DIR, str(tmp_path))
+    shared.reset_for_tests()
+    slo.reset()
+    try:
+        # the doomed peer's state, captured as real shard payloads
+        for v in (2.0, 2.0):
+            drift.observe("m", v, now=100.0)
+        slo.record("m", 0.01, 200)
+        dead_extras = {
+            "drift": drift.shard_payload(), "slo": slo.shard_payload(),
+        }
+        drift.reset()
+        slo.reset()
+
+        # survivor = this process: 3 drift observations + 2 slo requests
+        shared.register_extra("drift", drift.shard_payload)
+        shared.register_extra("slo", slo.shard_payload)
+        for v in (1.0, 1.0, 1.0):
+            drift.observe("m", v, now=100.0)
+        slo.record("m", 0.01, 200)
+        slo.record("m", 0.02, 200)
+        assert shared.flush(force=True, registry=telemetry.MetricsRegistry())
+
+        dead_pid = os.getpid() + 7
+        _write_fake_shard(dead_pid, dead_extras)
+
+        both = drift.merge_payloads(shared.fleet_extras("drift"))
+        assert both["m"]["recent_count"] == 5
+        assert both["m"]["baseline"][0] == 5
+
+        shared.mark_shard_dead(dead_pid)
+
+        after = drift.merge_payloads(shared.fleet_extras("drift"))
+        # exactly the survivor's window: 3 rows, mean 1.0 (not 0, not 5)
+        assert after["m"]["recent_count"] == 3
+        assert after["m"]["baseline"][0] == 3
+        assert after["m"]["recent_mean"] == pytest.approx(1.0)
+        slo_after = slo.merge_payloads(shared.fleet_extras("slo"))
+        assert slo_after["models"]["m"]["5m"]["requests"] == 2
+    finally:
+        shared.reset_for_tests()
+        slo.reset()
+
+
+# ------------------------------------------------------------------ queue
+def test_enqueue_is_exclusive_across_racers(tmp_path):
+    queue = str(tmp_path / "q")
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer(i):
+        barrier.wait()
+        if drift_queue.enqueue(queue, "m", {"detected_at": float(i)}):
+            wins.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert drift_queue.depth(queue) == 1
+
+
+def test_claim_is_exclusive_and_steals_stale(tmp_path):
+    queue = str(tmp_path / "q")
+    assert drift_queue.enqueue(queue, "m", {})
+    first = drift_queue.claim(queue, "m", host_id="a")
+    assert first is not None and first.generation == 1
+    # a live claim blocks a second rebuilder
+    assert drift_queue.claim(queue, "m", host_id="b") is None
+    # past the timeout the claim is stolen at the NEXT generation
+    stolen = drift_queue.claim(queue, "m", host_id="b", timeout_s=0.0)
+    assert stolen is not None and stolen.generation == 2
+    # the fenced-off original cannot complete
+    assert not drift_queue.complete(queue, first, {})
+    assert drift_queue.depth(queue) == 1  # request survived the zombie
+    # the living holder can
+    assert drift_queue.complete(queue, stolen, {"revision": "r"})
+    assert drift_queue.depth(queue) == 0
+    # ...and a future episode can enqueue again
+    assert drift_queue.enqueue(queue, "m", {})
+
+
+def test_claim_without_request_is_none(tmp_path):
+    assert drift_queue.claim(str(tmp_path / "q"), "ghost") is None
+
+
+def test_pending_skips_torn_request(tmp_path):
+    queue = str(tmp_path / "q")
+    assert drift_queue.enqueue(queue, "ok-machine", {})
+    torn = os.path.join(queue, drift_queue.REQUESTS_DIRNAME, "torn.json")
+    with open(torn, "w") as fh:
+        fh.write("{not json")
+    assert [r["machine"] for r in drift_queue.pending(queue)] == ["ok-machine"]
+    assert drift_queue.depth(queue) == 2  # depth is a cheap file count
+
+
+# ---------------------------------------------------------------- hotswap
+def test_uncommitted_revision_is_invisible(tmp_path):
+    collection = tmp_path / "rev-base"
+    collection.mkdir()
+    half = tmp_path / "drift-000000000000001"
+    half.mkdir()
+    (half / "machine-1").mkdir()  # artifacts but NO commit marker
+    assert hotswap._delta_revisions(str(collection)) == []
+    assert hotswap.poll_once(str(collection)) == []
+
+
+def test_poll_swaps_committed_revisions_oldest_first(tmp_path, monkeypatch):
+    collection = tmp_path / "rev-base"
+    collection.mkdir()
+    for name in ("drift-000000000000002", "drift-000000000000001"):
+        rev = tmp_path / name
+        rev.mkdir()
+        (rev / hotswap.COMPLETE_MARKER).write_text(
+            json.dumps({"machines": ["m"], "revision": name})
+        )
+    calls = []
+    monkeypatch.setattr(
+        hotswap, "_swap_one",
+        lambda base, rev_dir, revision, machine:
+            calls.append((revision, machine)) or True,
+    )
+    assert hotswap.poll_once(str(collection)) == ["m", "m"]
+    assert [revision for revision, _m in calls] == [
+        "drift-000000000000001", "drift-000000000000002",
+    ]
+
+
+def test_lexical_fence_prevents_rollback(tmp_path, monkeypatch):
+    collection = tmp_path / "rev-base"
+    collection.mkdir()
+    rev = tmp_path / "drift-000000000000001"
+    rev.mkdir()
+    (rev / hotswap.COMPLETE_MARKER).write_text(
+        json.dumps({"machines": ["m"]})
+    )
+    hotswap._last_swapped["m"] = "drift-000000000000002"
+    monkeypatch.setattr(
+        hotswap, "_swap_one",
+        lambda *a: pytest.fail("an older revision must never swap in"),
+    )
+    assert hotswap.poll_once(str(collection)) == []
+
+
+def test_watcher_gated_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_HOT_SWAP", raising=False)
+    assert hotswap.start_watcher(str(tmp_path)) is None
+    assert not hotswap.enabled()
+
+
+def test_active_fast_path_without_overrides():
+    assert hotswap.active("anything") is None
+    with hotswap._lock:
+        hotswap._overrides["m"] = ("/somewhere", "drift-1")
+    assert hotswap.active("m") == ("/somewhere", "drift-1")
+    assert hotswap.active("other") is None
+
+
+# ------------------------------------------------- serving-cache eviction
+def test_keyed_lru_evicts_one_name_keeping_new_dir():
+    cache = server_utils._KeyedLru(maxsize=10)
+    for key in (("old", "m"), ("new", "m"), ("old", "other")):
+        cache.get_or_load(key, lambda key=key: f"value-{key}")
+    assert cache.evict_name("m", keep_dir="new") == 1
+    assert ("old", "m") not in cache._data
+    assert ("new", "m") in cache._data
+    assert ("old", "other") in cache._data
+
+
+def test_keyed_lru_bounded():
+    cache = server_utils._KeyedLru(maxsize=3)
+    for i in range(5):
+        cache.get_or_load(("d", f"m{i}"), lambda i=i: i)
+    assert len(cache._data) == 3
+    assert ("d", "m4") in cache._data and ("d", "m0") not in cache._data
+
+
+def test_evict_machine_clears_negative_cache(monkeypatch):
+    import time as _time
+
+    key = ("somedir", "m")
+    with server_utils._cache_lock:
+        server_utils._failed_loads[key] = (
+            _time.monotonic() + 3600, RuntimeError("old failure"),
+        )
+    server_utils.evict_machine("m", keep_dir="somedir")
+    # keep_dir protects positive entries, NEVER a negative one: the
+    # rebuilt artifact must become loadable immediately
+    with server_utils._cache_lock:
+        assert key not in server_utils._failed_loads

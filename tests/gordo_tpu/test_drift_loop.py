@@ -1,0 +1,337 @@
+"""
+Chaos acceptance for the self-healing drift loop (ISSUE 13, tentpole
+layer 4): 12 machines serve under live threaded load while 2 of them
+receive drifted sensor data. The loop must close end to end — detect
+(views -> observability/drift.py), trigger (one deduplicated rebuild
+request per drifted machine), rebuild (warm-start delta revision of
+EXACTLY the drifted machines), swap (atomic cutover, in-flight requests
+unharmed) — with zero 5xx anywhere, zero steady-state trace compiles
+after the swap, hysteresis suppressing a second enqueue for the same
+episode, and the rebuilt models' drift scores recalibrating to their
+new normal.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+from gordo_tpu.builder import drift_rebuild
+from gordo_tpu.observability import drift
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.parallel import BatchedModelBuilder, drift_queue
+from gordo_tpu.server import batcher as batcher_mod
+from gordo_tpu.server import build_app, hotswap
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.workflow.normalized_config import NormalizedConfig
+
+pytestmark = pytest.mark.chaos
+
+N_MACHINES = 12
+DRIFTED = ("dl-0", "dl-1")
+PROJECT = "drift-loop"
+N_TAGS = 4
+
+
+def _machine_block(name):
+    tags = "".join(f"\n      - {name}-tag-{j}" for j in range(N_TAGS))
+    return f"""
+  - name: {name}
+    dataset:
+      tags:{tags}
+      target_tag_list:{tags}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+      data_provider: {{type: RandomDataProvider}}
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        require_thresholds: false
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+            - sklearn.preprocessing.MinMaxScaler
+            - gordo_tpu.models.models.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: 1
+"""
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """12 trained machines in a revision dir, registered for warm-start."""
+    root = tmp_path_factory.mktemp("drift-loop")
+    collection = root / "rev-initial"
+    register = root / "register"
+    cfg = "machines:" + "".join(
+        _machine_block(f"dl-{i}") for i in range(N_MACHINES)
+    )
+    machines = NormalizedConfig(
+        yaml.safe_load(cfg), project_name=PROJECT
+    ).machines
+    results = BatchedModelBuilder(
+        machines,
+        output_dir=str(collection),
+        model_register_dir=str(register),
+    ).build()
+    assert len(results) == N_MACHINES
+    return {
+        "root": str(root),
+        "collection": str(collection),
+        "register": str(register),
+        "queue": str(root / "queue"),
+        "machines": machines,
+        "names": [m.name for m in machines],
+    }
+
+
+def _payload_variants(rng):
+    """Three stable request payloads per machine (±10% input scale) so
+    each model's reconstruction-error stream has genuine variance — a
+    frozen zero-variance baseline would read float jitter as drift."""
+    base = rng.rand(20, N_TAGS)
+    return [
+        {"X": (base * scale).tolist(), "y": (base * scale).tolist()}
+        for scale in (0.9, 1.0, 1.1)
+    ]
+
+
+class _Load:
+    """Open-loop-ish threaded load: every machine, strict per-machine
+    payload-variant rotation, per-machine revision-header transitions."""
+
+    def __init__(self, app, names):
+        self.app = app
+        self.names = names
+        rng = np.random.RandomState(13)
+        self.variants = {name: _payload_variants(rng) for name in names}
+        self.counts = {name: 0 for name in names}
+        self.revisions = {name: [] for name in names}
+        self.status_5xx = 0
+        self.requests = 0
+        self.lock = threading.Lock()
+        self.stop = threading.Event()
+        self.threads = []
+
+    def _next(self, tid, i):
+        name = self.names[(tid + i) % len(self.names)]
+        with self.lock:
+            variant = self.variants[name][self.counts[name] % 3]
+            self.counts[name] += 1
+        return name, variant
+
+    def _run(self, tid):
+        client = self.app.test_client()
+        i = 0
+        while not self.stop.is_set():
+            name, variant = self._next(tid, i)
+            i += 1
+            resp = client.post(
+                f"/gordo/v0/{PROJECT}/{name}/anomaly/prediction",
+                json=variant,
+            )
+            revision = resp.headers.get("revision")
+            with self.lock:
+                self.requests += 1
+                if resp.status_code >= 500:
+                    self.status_5xx += 1
+                seen = self.revisions[name]
+                if revision and (not seen or seen[-1] != revision):
+                    seen.append(revision)
+
+    def start(self, n=3):
+        for tid in range(n):
+            thread = threading.Thread(target=self._run, args=(tid,),
+                                      daemon=True)
+            thread.start()
+            self.threads.append(thread)
+
+    def halt(self):
+        self.stop.set()
+        for thread in self.threads:
+            thread.join(timeout=30)
+
+
+def _wait(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    pytest.fail(f"timed out after {timeout_s}s waiting for {what}")
+
+
+def test_self_healing_drift_loop(fleet, monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_DRIFT_DETECT", "1")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_MIN_SAMPLES", "6")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_THRESHOLD", "4.0")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_COOLDOWN_S", "3600")
+    monkeypatch.setenv("GORDO_TPU_DRIFT_QUEUE_DIR", fleet["queue"])
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setenv("N_CACHED_MODELS", "32")
+    monkeypatch.delenv("GORDO_TPU_HOT_SWAP", raising=False)
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    server_utils.clear_model_caches()
+    drift.reset()
+    hotswap.reset_for_tests()
+
+    app = build_app({"MODEL_COLLECTION_DIR": fleet["collection"]})
+    # boot warmup, as production would: params banked + programs AOT
+    from gordo_tpu.server.warmup import warmup_collection
+
+    assert warmup_collection(fleet["collection"])["failed"] == []
+
+    load = _Load(app, fleet["names"])
+    load.start(n=3)
+    try:
+        # -------- detect: live traffic seeds every machine's baseline
+        _wait(
+            lambda: all(
+                drift.snapshot().get(n, {}).get("status") == "ok"
+                for n in fleet["names"]
+            ),
+            timeout_s=120,
+            what="all 12 baselines to freeze",
+        )
+
+        # drifted sensor feed on exactly 2 machines: same serving path,
+        # 15x out-of-range inputs — the views' recorded reconstruction
+        # error must trip CUSUM and enqueue ONE rebuild per machine
+        injector = app.test_client()
+        for name in DRIFTED:
+            drifted = (np.asarray(load.variants[name][1]["X"]) * 15.0).tolist()
+            drifted_payload = {"X": drifted, "y": drifted}
+            for _attempt in range(100):
+                resp = injector.post(
+                    f"/gordo/v0/{PROJECT}/{name}/anomaly/prediction",
+                    json=drifted_payload,
+                )
+                assert resp.status_code < 500
+                if drift.snapshot()[name]["status"] == "drifted":
+                    break
+            else:
+                pytest.fail(f"{name} never detected as drifted")
+
+        pending = sorted(
+            r["machine"] for r in drift_queue.pending(fleet["queue"])
+        )
+        assert pending == sorted(DRIFTED)
+
+        # -------- hysteresis: the SAME episode cannot enqueue twice
+        events_before = {
+            n: drift.snapshot()[n]["events"] for n in DRIFTED
+        }
+        for name in DRIFTED:
+            drifted = (np.asarray(load.variants[name][1]["X"]) * 15.0).tolist()
+            drifted_payload = {"X": drifted, "y": drifted}
+            for _ in range(5):
+                injector.post(
+                    f"/gordo/v0/{PROJECT}/{name}/anomaly/prediction",
+                    json=drifted_payload,
+                )
+        assert drift_queue.depth(fleet["queue"]) == len(DRIFTED)
+        for name in DRIFTED:
+            assert drift.snapshot()[name]["events"] == events_before[name]
+
+        # -------- rebuild: drain into a warm-start delta revision of
+        # EXACTLY the drifted machines
+        warm_before = metric_catalog.WARM_STARTS.value()
+        report = drift_rebuild.drain_drift_queue(
+            fleet["machines"],
+            fleet["queue"],
+            fleet["root"],
+            model_register_dir=fleet["register"],
+        )
+        assert sorted(report["built"]) == sorted(DRIFTED)
+        assert report["failed"] == []
+        assert report["revision"] is not None
+        # warm-start counter: the 2 drifted machines and NOTHING else
+        assert metric_catalog.WARM_STARTS.value() - warm_before == 2
+        for name in DRIFTED:
+            assert metric_catalog.DRIFT_REBUILDS.value(model=name) == 1
+        for name in set(fleet["names"]) - set(DRIFTED):
+            assert metric_catalog.DRIFT_REBUILDS.value(model=name) == 0
+        assert drift_queue.depth(fleet["queue"]) == 0
+
+        # -------- swap: atomic cutover under load
+        swapped = hotswap.poll_once(fleet["collection"])
+        assert sorted(swapped) == sorted(DRIFTED)
+        for name in DRIFTED:
+            assert metric_catalog.HOT_SWAPS.value(model=name) == 1
+            assert hotswap.active(name) is not None
+        time.sleep(0.5)  # let requests in flight at the cutover finish
+        compiles_after_swap = metric_catalog.TRACE_COMPILES.value()
+        post_swap_floor = load.requests + 3 * len(fleet["names"])
+        _wait(
+            lambda: load.requests >= post_swap_floor,
+            timeout_s=120,
+            what="post-swap traffic over every machine",
+        )
+        # zero steady-state trace compiles after the swap: same spec,
+        # same bucket, bank slot replaced in place
+        assert metric_catalog.TRACE_COMPILES.value() == compiles_after_swap
+
+        # -------- recalibrate: rebuilt models settle at their NEW normal
+        _wait(
+            lambda: all(
+                drift.snapshot().get(n, {}).get("status") == "ok"
+                and drift.snapshot()[n]["events"] == 0
+                for n in DRIFTED
+            ),
+            timeout_s=120,
+            what="rebuilt models to recalibrate",
+        )
+    finally:
+        load.halt()
+
+    # -------- zero downtime, correct routing
+    assert load.status_5xx == 0, (
+        f"{load.status_5xx} 5xx of {load.requests} requests"
+    )
+    assert load.requests > 0
+    for name in fleet["names"]:
+        seen = load.revisions[name]
+        if name in DRIFTED:
+            assert seen[-1] == report["revision"], (name, seen)
+            assert seen[0] == "rev-initial"
+        else:
+            assert seen == ["rev-initial"], (name, seen)
+
+    # the delta revision only holds the drifted machines + the marker
+    rev_dir = os.path.join(fleet["root"], report["revision"])
+    artifact_dirs = sorted(
+        n for n in os.listdir(rev_dir)
+        if os.path.isdir(os.path.join(rev_dir, n))
+    )
+    assert artifact_dirs == sorted(DRIFTED)
+    with open(os.path.join(rev_dir, hotswap.COMPLETE_MARKER)) as fh:
+        marker = json.load(fh)
+    assert marker["machines"] == sorted(DRIFTED)
+
+
+def test_prewarm_accepts_explicit_revision(fleet, monkeypatch):
+    """Satellite: ``POST /debug/prewarm`` warms a named sibling revision
+    (the gateway's pre-cutover warm target); unknown revisions are 410
+    like the prediction routes."""
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    app = build_app({"MODEL_COLLECTION_DIR": fleet["collection"]})
+    client = app.test_client()
+
+    resp = client.post(
+        "/debug/prewarm?machine=dl-0&revision=rev-initial"
+    )
+    assert resp.status_code == 200
+    body = resp.get_json()
+    assert body["revision"] == "rev-initial"
+    assert body["failed"] == []
+
+    resp = client.post("/debug/prewarm?machine=dl-0&revision=no-such-rev")
+    assert resp.status_code == 410
+
+    resp = client.post("/debug/prewarm?machine=dl-0&revision=..%2Fescape")
+    assert resp.status_code == 410

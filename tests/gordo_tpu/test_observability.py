@@ -95,7 +95,7 @@ def test_latency_panels_use_quantiles_not_averages():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 6
+    assert len(paths) == 7
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
