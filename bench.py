@@ -1014,21 +1014,24 @@ def _rerun_improves(rerun: dict, original: dict) -> bool:
 # budget pressure can't cost the round its tail-latency record.
 SECTION_NAMES = (
     "tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
-    "fleet_build", "drift_loop",
+    "fleet_build", "drift_loop", "cold_start",
 )
 SECTION_STATUSES = (
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",
 )
-RECORD_SCHEMA_VERSION = 4
+RECORD_SCHEMA_VERSION = 5
 # Older records stay valid against the section list of THEIR schema
 # version (the record lint looks the version up here): a v2 record has no
 # fleet_build section and must not start failing when v3 adds one, nor a
-# v3 record when v4 adds drift_loop.
+# v3 record when v4 adds drift_loop or a v4 record when v5 adds
+# cold_start.
 SECTION_NAMES_BY_VERSION = {
     2: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"),
     3: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build"),
-    4: SECTION_NAMES,
+    4: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build", "drift_loop"),
+    5: SECTION_NAMES,
 }
 
 
@@ -1062,6 +1065,7 @@ _SECTION_MIN_USEFUL = {
     "batch_ab": 300,
     "fleet_build": 240,
     "drift_loop": 180,
+    "cold_start": 180,
 }
 
 
@@ -1107,6 +1111,13 @@ def _section_timeout(name: str) -> int:
     ):
         # two tiny model builds + one warm-start delta rebuild under a
         # short load window — bounded like the other small sections
+        timeout = min(timeout, 900)
+    if (
+        name == "cold_start"
+        and "BENCH_SECTION_TIMEOUT_COLD_START" not in os.environ
+    ):
+        # one tiny shipped-programs fleet build + two fresh-process boot
+        # arms — bounded like the other small sections
         timeout = min(timeout, 900)
     if name == "windowed" and "BENCH_SECTION_TIMEOUT_WINDOWED" not in os.environ:
         # four families (LSTM AE/forecast, Transformer, TCN), each with a
@@ -1897,6 +1908,137 @@ def _bench_drift_loop() -> dict:
     }
 
 
+# the cold-start arm driver: a FRESH python process that boots a serving
+# node (warmup + first fused predict) and prints one JSON line — the
+# parent interpolates nothing but paths, so the measured process pays
+# interpreter + jax import + warmup exactly like a real cold node
+_COLD_START_DRIVER = """
+import json, os, sys, time
+t0 = time.time()
+sys.path.insert(0, {repo!r})
+import numpy as np
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.server import warmup
+from gordo_tpu.server.utils import load_metadata, load_model
+collection = {collection!r}
+report = warmup.warmup_collection(collection)
+name = sorted(
+    n for n in os.listdir(collection)
+    if os.path.isdir(os.path.join(collection, n))
+)[0]
+meta = load_metadata(collection, name)
+tags = (
+    meta.get("dataset", {{}}).get("tags")
+    or meta.get("dataset", {{}}).get("tag_list") or []
+)
+model = load_model(collection, name)
+model.predict(np.zeros((100, len(tags)), np.float32))
+print(json.dumps({{
+    "time_to_first_fused_s": round(time.time() - t0, 3),
+    "serve_time_compiles": metric_catalog.TRACE_COMPILES.value(),
+    "aot_shipped": report.get("aot_shipped", 0),
+    "aot_rejected": report.get("aot_rejected", 0),
+    "aot_programs": report.get("aot_programs", 0),
+    "warmup_seconds": report.get("seconds"),
+    "compile_seconds_saved": report.get("compile_seconds_saved"),
+}}))
+"""
+
+
+def _bench_cold_start() -> dict:
+    """Build-to-serve cold start (ISSUE 14): build a tiny fleet with
+    ``GORDO_TPU_SHIP_PROGRAMS=1`` so the artifacts carry their fused
+    serving executables, then boot a serving node from scratch twice —
+    once ignoring the shipped programs (the old world: every program
+    re-traced and re-compiled at warmup) and once deserializing them —
+    each arm a FRESH process with a FRESH persistent-cache dir, so
+    neither can steal warmth from the build or from the other arm.
+    Reported per arm: wall from process start to the first fused predict
+    response, and the serve-side trace-compile count (with shipped
+    programs it must be ~0 — that is the tentpole's claim)."""
+    import subprocess
+    import tempfile
+
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import BatchedModelBuilder
+
+    root = tempfile.mkdtemp(prefix="bench-coldstart-")
+    collection = os.path.join(root, "collection")
+    # ship at build: the fleet is small (2 <= the bank's capacity floor of
+    # 8), so the shipped programs' baked-in capacity matches what the
+    # serving bank will actually allocate
+    os.environ["GORDO_TPU_SHIP_PROGRAMS"] = "1"
+    machines = [
+        Machine.from_config(
+            _machine_config(f"coldstart-{i}"), project_name="bench"
+        )
+        for i in range(2)
+    ]
+    BatchedModelBuilder(machines, output_dir=collection).build()
+    shipped_files = 0
+    for machine in machines:
+        manifest = os.path.join(
+            collection, machine.name, "programs", "manifest.json"
+        )
+        if os.path.exists(manifest):
+            with open(manifest) as fh:
+                shipped_files += len(json.load(fh).get("programs") or [])
+    if shipped_files == 0:
+        raise RuntimeError("build shipped no AOT programs")
+
+    driver = _COLD_START_DRIVER.format(
+        repo=os.path.dirname(os.path.abspath(__file__)),
+        collection=collection,
+    )
+
+    def boot_arm(load_shipped: bool) -> dict:
+        cache_dir = tempfile.mkdtemp(
+            prefix=f"bench-coldstart-cache-{int(load_shipped)}-", dir=root
+        )
+        env = {
+            **os.environ,
+            "GORDO_TPU_SERVING_BATCH": "1",
+            "GORDO_TPU_LOAD_SHIPPED_PROGRAMS": "1" if load_shipped else "0",
+            # a fresh EMPTY persistent cache per arm: the measured compile
+            # bill must be the arm's own, not a warm-cache hit
+            "JAX_COMPILATION_CACHE_DIR": cache_dir,
+        }
+        proc = subprocess.run(
+            [sys.executable, "-c", driver],
+            env=env, capture_output=True, text=True, timeout=420,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cold-start arm (load_shipped={load_shipped}) failed "
+                f"rc={proc.returncode}: {proc.stderr[-500:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    without = boot_arm(False)
+    with_shipped = boot_arm(True)
+    if with_shipped.get("aot_shipped", 0) <= 0:
+        raise RuntimeError(
+            f"with-shipped arm deserialized nothing: {with_shipped}"
+        )
+    speedup = None
+    if with_shipped.get("time_to_first_fused_s"):
+        speedup = round(
+            without["time_to_first_fused_s"]
+            / with_shipped["time_to_first_fused_s"], 2,
+        )
+    return {
+        # flat-key sources: the WITH-shipped arm is the product claim
+        "time_to_first_fused_s": with_shipped["time_to_first_fused_s"],
+        "serve_time_compiles": with_shipped["serve_time_compiles"],
+        "without_time_to_first_fused_s": without["time_to_first_fused_s"],
+        "without_serve_time_compiles": without["serve_time_compiles"],
+        "speedup": speedup,
+        "programs_shipped": shipped_files,
+        "with_shipped": with_shipped,
+        "without_shipped": without,
+    }
+
+
 def _section_child(name: str) -> None:
     """Child entrypoint: resolve a backend the same way main() does, run the
     section, print its ``{"platform", "result"}`` envelope as the last
@@ -1912,6 +2054,7 @@ def _section_child(name: str) -> None:
         "batch_ab": _bench_batch_ab,
         "fleet_build": _bench_fleet_build,
         "drift_loop": _bench_drift_loop,
+        "cold_start": _bench_cold_start,
     }
     result = sections[name]()
     envelope = {"platform": jax.devices()[0].platform, "result": result}
@@ -2009,6 +2152,8 @@ def main():
             enabled.remove("fleet_build")
         if os.environ.get("BENCH_DRIFT_LOOP", "1") == "0":
             enabled.remove("drift_loop")
+        if os.environ.get("BENCH_COLD_START", "1") == "0":
+            enabled.remove("cold_start")
 
     # every canonical section appears in the record, disabled ones
     # included — "no section unaccounted for" is the schema's core promise
@@ -2163,6 +2308,7 @@ def _emit_record(sections: dict, recovered: list):
     serving_load = sections.get("serving_load") or {}
     fleet_build = sections.get("fleet_build") or {}
     drift_loop = sections.get("drift_loop") or {}
+    cold_start = sections.get("cold_start") or {}
     head = headline.get("result") or {}
 
     serving = head.get("serving", {})
@@ -2183,6 +2329,7 @@ def _emit_record(sections: dict, recovered: list):
     if not platform:
         for entry in (
             smoke, serving_load, windowed, batch_ab, fleet_build, drift_loop,
+            cold_start,
         ):
             if entry.get("platform"):
                 platform = entry["platform"]
@@ -2201,6 +2348,7 @@ def _emit_record(sections: dict, recovered: list):
         "batch_ab": batch_ab,
         "fleet_build": fleet_build,
         "drift_loop": drift_loop,
+        "cold_start": cold_start,
         "platform": platform,
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
         "sections": {
@@ -2224,6 +2372,7 @@ def _emit_record(sections: dict, recovered: list):
     ab = batch_ab.get("result") or {}
     fb = fleet_build.get("result") or {}
     dl = drift_loop.get("result") or {}
+    cs = cold_start.get("result") or {}
     smoke_res = smoke.get("result") or {}
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
@@ -2367,6 +2516,23 @@ def _emit_record(sections: dict, recovered: list):
             "warm_starts": dl.get("warm_starts"),
             "revision": dl.get("revision"),
             "revisions_seen": dl.get("revisions_seen"),
+        },
+        # build-to-serve cold start (ISSUE 14): flat keys so
+        # bench_compare.py gates the with-shipped-programs boot wall and
+        # the serve-side compile count (~0 is the tentpole claim) like
+        # any headline metric
+        "cold_start_time_to_first_fused_s": cs.get("time_to_first_fused_s"),
+        "cold_start_serve_time_compiles": cs.get("serve_time_compiles"),
+        "cold_start": {
+            "platform": cold_start.get("platform"),
+            "speedup": cs.get("speedup"),
+            "without_time_to_first_fused_s": cs.get(
+                "without_time_to_first_fused_s"
+            ),
+            "without_serve_time_compiles": cs.get(
+                "without_serve_time_compiles"
+            ),
+            "programs_shipped": cs.get("programs_shipped"),
         },
         "detail_file": detail_file,
         # schema v2: every canonical section accounted for with an

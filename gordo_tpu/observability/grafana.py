@@ -830,6 +830,34 @@ def fleet_dashboard() -> Dict[str, Any]:
             y=3 * _PANEL_H,
             unit="s",
         ),
+        _timeseries(
+            "AOT serving programs by source",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_aot_programs_total"
+                    "[5m])) by (source)",
+                    "legend": "{{source}}",
+                },
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_prelower_failures_total[5m]))",
+                    "legend": "prelower failures",
+                },
+            ],
+            panel_id=10,
+            x=0,
+            y=4 * _PANEL_H,
+            description=(
+                "Build-to-serve pipeline (ISSUE 14): shipped = fused "
+                "executables deserialized from the artifact's programs/ "
+                "manifest (cold-node warmth without compiling), compiled "
+                "= warmup pre-lowered them on this node, rejected = a "
+                "shipped manifest failed the host-fingerprint ladder "
+                "(real ISA mismatch) and serving fell back to the jit "
+                "path — sustained rejected or prelower-failure rates "
+                "mean cold nodes are paying compiles they shouldn't"
+            ),
+        ),
     ]
     return _dashboard("Gordo TPU fleet", "gordo-tpu-fleet", panels)
 

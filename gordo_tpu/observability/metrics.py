@@ -230,6 +230,25 @@ TRACE_COMPILES = telemetry.counter(
     "AOT pre-lowering exists to pay these before traffic, so a non-zero "
     "steady-state rate means requests are eating compile walls",
 )
+# ------------------------------- build-to-serve AOT programs (ISSUE 14)
+# wired by server/batcher.py (prelower / load_shipped) and server/warmup.py
+AOT_PROGRAMS = telemetry.counter(
+    "gordo_server_aot_programs_total",
+    "Fused serving executables that entered the batcher's AOT program "
+    "cache, by source: shipped (deserialized from the artifact's "
+    "programs/ manifest — no trace, no XLA compile), compiled (lowered "
+    "and compiled fresh at warmup), or rejected (a shipped manifest whose "
+    "host fingerprint differs on real ISA features — never executed, the "
+    "jit path serves instead)",
+    ("source",),
+)
+PRELOWER_FAILURES = telemetry.counter(
+    "gordo_server_prelower_failures_total",
+    "AOT pre-lower attempts that failed and fell back to the lazy jit "
+    "path (prelower is best-effort per fuse width; before this counter "
+    "the failures were log-only and a cold fuse bucket at serve time had "
+    "no signal to explain it)",
+)
 # ------------------------------------------------ flight recorder (PR 5)
 # wired by observability/flight.py; read back through /debug/flight
 FLIGHT_RECORDED = telemetry.counter(

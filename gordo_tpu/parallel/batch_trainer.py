@@ -46,6 +46,7 @@ from sklearn.preprocessing import MinMaxScaler
 
 from gordo_tpu import __version__, serializer
 from gordo_tpu.builder.build_model import ModelBuilder
+from gordo_tpu.serializer import programs
 from gordo_tpu.dataset import GordoBaseDataset
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.metadata import (
@@ -840,6 +841,21 @@ class BatchedModelBuilder:
             "serialize", _PHASE_SERIALIZE, machine=machine_out.name
         ):
             serializer.dump(model, model_dir, metadata=machine_out.to_dict())
+        # build-to-serve (ISSUE 14): ship the fused serving executables
+        # alongside the params so a cold serving node deserializes instead
+        # of compiling. Best-effort — a shipping failure costs warmth on
+        # the serving side, never the build.
+        if programs.ship_enabled():
+            try:
+                programs.ship_programs(
+                    model, model_dir, expected_fleet=len(self.machines)
+                )
+            except Exception as exc:  # noqa: BLE001
+                logger.warning(
+                    "Machine %s: shipping AOT serving programs failed "
+                    "(%s: %s); artifact serves via the jit/prelower path",
+                    machine_out.name, type(exc).__name__, exc,
+                )
         if self.model_register_dir:
             from gordo_tpu.util import disk_registry
 

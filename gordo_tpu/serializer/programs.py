@@ -1,0 +1,355 @@
+"""
+Build-to-serve compiled-artifact pipeline (ISSUE 14): ship the fused
+serving executables WITH the artifact, so a cold serving node loads
+programs instead of compiling them.
+
+The build fleet already compiles every serving-program signature once
+(the elastic scheduler even places work to minimize duplicate compiles)
+— yet every serving node used to re-pay the whole trace+XLA-compile bill
+at warmup. This module extends the artifact contract so a build emits,
+next to ``model.pkl`` and ``metadata.json``::
+
+    <artifact>/
+      model.pkl
+      metadata.json
+      programs/
+        manifest.json            <- schema, host fingerprint, entry index
+        <speckey>-n<rows>-b<fuse>-c<cap>.jaxprog   <- one per program
+
+Each ``.jaxprog`` is a pickled ``(payload, in_tree, out_tree)`` triple
+from ``jax.experimental.serialize_executable`` — the exact stacked
+serving program ``CrossModelBatcher._stacked_apply`` would compile,
+keyed the same way: ``(spec, n_pad, fuse width, bank capacity)``. The
+serving loader (warmup / ``CrossModelBatcher.load_shipped``) installs
+them straight into the batcher's ``_aot`` cache WITHOUT touching
+trace-time Python: a deserialized executable never re-traces, so
+``gordo_server_trace_compiles_total`` stays at ~0 from process start.
+
+**The fingerprint ladder.** XLA:CPU AOT executables bake in the compile
+host's CPU features; loading one on a genuinely different host can
+SIGILL. The manifest therefore records the builder's host fingerprint
+(util/xla_cache.host_fingerprint) plus the raw ingredients (platform,
+machine arch, CPU feature set, jaxlib version), and the loader walks a
+ladder before any payload byte is deserialized:
+
+1. platform or manifest schema mismatch -> **rejected**;
+2. fingerprint equal -> **match** (load);
+3. same machine arch + jaxlib AND the CPU-feature diff is only the
+   cosmetic XLA tuning pseudo-features (``prefer-no-gather`` /
+   ``prefer-no-scatter`` — util/xla_cache's feature-set classifier)
+   -> **cosmetic** (load: those cannot SIGILL);
+4. anything else -> **rejected**, loudly: every entry counts into
+   ``gordo_server_aot_programs_total{source="rejected"}`` and serving
+   falls back to the ordinary jit/prelower path. A rejected artifact's
+   programs are never executed.
+
+Both sides are opt-in and default OFF (`GORDO_TPU_SHIP_PROGRAMS` at
+build, ``GORDO_TPU_LOAD_SHIPPED_PROGRAMS`` at serve): with the knobs
+unset, artifacts and serving behavior are byte-identical to before.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+SHIP_ENV = "GORDO_TPU_SHIP_PROGRAMS"
+LOAD_ENV = "GORDO_TPU_LOAD_SHIPPED_PROGRAMS"
+
+PROGRAMS_DIR = "programs"
+MANIFEST_NAME = "manifest.json"
+PROGRAM_SUFFIX = ".jaxprog"
+MANIFEST_SCHEMA_VERSION = 1
+
+# the fuse-width buckets _device_call grows batches through (1->4->16->64)
+DEFAULT_FUSE_WIDTHS = (1, 4, 16, 64)
+
+
+def ship_enabled() -> bool:
+    return os.environ.get(SHIP_ENV, "").lower() in ("1", "true", "yes")
+
+
+def load_enabled() -> bool:
+    return os.environ.get(LOAD_ENV, "").lower() in ("1", "true", "yes")
+
+
+def spec_key(spec) -> str:
+    """Short stable key for one ModelSpec, computed identically at build
+    and load time (ModelSpec is a frozen dataclass, so its repr is a
+    deterministic function of its fields)."""
+    return hashlib.sha1(repr(spec).encode()).hexdigest()[:12]
+
+
+def program_filename(skey: str, n_pad: int, b_pad: int, capacity: int) -> str:
+    return f"{skey}-n{n_pad}-b{b_pad}-c{capacity}{PROGRAM_SUFFIX}"
+
+
+def manifest_path(artifact_dir: str) -> str:
+    return os.path.join(artifact_dir, PROGRAMS_DIR, MANIFEST_NAME)
+
+
+def ship_capacity(expected_fleet: int) -> int:
+    """The param-bank capacity bucket to compile shipped programs at:
+    the same power-of-two growth rule (floor 8, ceiling
+    ``GORDO_TPU_PARAM_BANK_MAX``) ``_ParamBank`` applies when the serving
+    node registers ``expected_fleet`` models. A shipped program only
+    loads when its baked-in capacity equals the serving bank's capacity
+    at prelower time — fleets within one bucket of the build's
+    expectation hit, anything else quietly falls back to a fresh
+    compile."""
+    raw = os.environ.get("GORDO_TPU_PARAM_BANK_MAX", "")
+    try:
+        configured = int(raw) if raw.strip() else 0
+    except ValueError:
+        configured = 0
+    max_models = configured if configured > 0 else 512
+    cap = 8
+    while cap < expected_fleet:
+        cap <<= 1
+    return min(cap, max(8, max_models))
+
+
+# ---------------------------------------------------------------- build side
+def _artifact_shapes(artifact_dir: str) -> Tuple[int, int]:
+    """(n_features, model_offset) read from the artifact's metadata.json —
+    the same extraction serving warmup performs, so the shipped programs
+    cover exactly the row buckets warmup would compile."""
+    with open(os.path.join(artifact_dir, "metadata.json")) as fh:
+        metadata = json.load(fh)
+    tags = (
+        metadata.get("dataset", {}).get("tags")
+        or metadata.get("dataset", {}).get("tag_list")
+        or []
+    )
+    offset = (
+        metadata.get("metadata", {})
+        .get("build_metadata", {})
+        .get("model", {})
+        .get("model_offset", 0)
+    )
+    if not tags:
+        raise ValueError("no tags in artifact metadata")
+    return len(tags), int(offset)
+
+
+def host_descriptor() -> Dict[str, Any]:
+    """The manifest's host block: fingerprint plus its raw ingredients, so
+    a loading host can classify a mismatch instead of just observing it."""
+    import platform
+
+    import jax
+
+    from gordo_tpu.util import xla_cache
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "")
+    except Exception:  # noqa: BLE001 — mirror host_fingerprint's tolerance
+        jaxlib_version = ""
+    return {
+        "fingerprint": xla_cache.host_fingerprint(),
+        "platform": jax.default_backend(),
+        "machine": platform.machine(),
+        "cpu_features": sorted(xla_cache.host_cpu_features()),
+        "jaxlib": jaxlib_version,
+    }
+
+
+def ship_programs(
+    model,
+    artifact_dir: str,
+    expected_fleet: int = 1,
+    bucket_rows: Optional[Tuple[int, ...]] = None,
+    fuse_widths: Tuple[int, ...] = DEFAULT_FUSE_WIDTHS,
+) -> int:
+    """Lower, compile, and serialize the artifact's stacked serving
+    programs into ``<artifact>/programs/`` with a manifest. Returns how
+    many programs were written. Call AFTER ``serializer.dump`` — the
+    shapes come from the artifact's own metadata.json.
+
+    Best-effort per program: a width that fails to compile or serialize
+    is logged and skipped; the manifest indexes exactly what is on disk.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import serialize_executable
+
+    from gordo_tpu.ops.train import pad_for_predict
+    from gordo_tpu.serializer.serializer import _atomic_write
+    from gordo_tpu.server.batcher import _stacked_apply
+    from gordo_tpu.server.warmup import _default_bucket_rows, _jax_estimators
+
+    n_features, offset = _artifact_shapes(artifact_dir)
+    if bucket_rows is None:
+        bucket_rows = _default_bucket_rows()
+    capacity = ship_capacity(max(1, int(expected_fleet)))
+    max_batch = int(os.environ.get("GORDO_TPU_BATCH_MAX", "64"))
+
+    programs_dir = os.path.join(artifact_dir, PROGRAMS_DIR)
+    entries: List[Dict[str, Any]] = []
+    written = set()
+    for estimator in _jax_estimators(model):
+        spec = estimator.spec_
+        skey = spec_key(spec)
+        bank_shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct((capacity,) + a.shape, a.dtype),
+            estimator.params_,
+        )
+        for bucket in bucket_rows:
+            X = np.zeros((int(bucket) + offset, n_features), np.float32)
+            X_pad, n_pad, _ = pad_for_predict(spec, X)
+            for width in fuse_widths:
+                b_pad = min(int(width), max_batch)
+                fname = program_filename(skey, n_pad, b_pad, capacity)
+                if fname in written:
+                    continue
+                x_shape = (b_pad,) + X_pad.shape
+                t0 = time.monotonic()
+                try:
+                    program = _stacked_apply(spec, n_pad, b_pad, capacity)
+                    executable = program.lower(
+                        bank_shapes,
+                        jax.ShapeDtypeStruct((b_pad,), np.int32),
+                        jax.ShapeDtypeStruct(x_shape, X_pad.dtype),
+                    ).compile()
+                    triple = serialize_executable.serialize(executable)
+                    blob = pickle.dumps(triple, protocol=4)
+                except Exception as exc:  # noqa: BLE001 — per-program
+                    logger.warning(
+                        "shipping AOT program %s failed (artifact still "
+                        "serves via the jit path): %s", fname, exc,
+                    )
+                    continue
+                compile_s = time.monotonic() - t0
+                os.makedirs(programs_dir, exist_ok=True)
+                _atomic_write(
+                    os.path.join(programs_dir, fname),
+                    lambda f, blob=blob: f.write(blob),
+                    "wb",
+                )
+                written.add(fname)
+                entries.append(
+                    {
+                        "file": fname,
+                        "spec_key": skey,
+                        "n_pad": int(n_pad),
+                        "b_pad": int(b_pad),
+                        "capacity": int(capacity),
+                        "x_shape": [int(d) for d in x_shape],
+                        "dtype": str(X_pad.dtype),
+                        "compile_s": round(compile_s, 3),
+                    }
+                )
+    if not entries:
+        return 0
+    manifest = {
+        "schema_version": MANIFEST_SCHEMA_VERSION,
+        **host_descriptor(),
+        "programs": entries,
+    }
+    _atomic_write(
+        manifest_path(artifact_dir),
+        lambda f: json.dump(manifest, f, indent=1),
+        "w",
+    )
+    logger.info(
+        "shipped %d AOT serving program(s) with artifact %s "
+        "(capacity %d, buckets %s)",
+        len(entries), artifact_dir, capacity, tuple(bucket_rows),
+    )
+    return len(entries)
+
+
+# ---------------------------------------------------------------- serve side
+def load_manifest(artifact_dir: str) -> Optional[Dict[str, Any]]:
+    """The artifact's programs manifest, or None when it has none (the
+    overwhelmingly common case for artifacts built without shipping)."""
+    try:
+        with open(manifest_path(artifact_dir)) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
+
+
+def classify_manifest(manifest: Dict[str, Any]) -> Tuple[str, str]:
+    """Walk the fingerprint ladder for one manifest:
+    ``("match" | "cosmetic", "")`` means its programs may load;
+    ``("rejected", reason)`` means they must never execute here."""
+    import jax
+
+    from gordo_tpu.util import xla_cache
+
+    if manifest.get("schema_version") != MANIFEST_SCHEMA_VERSION:
+        return "rejected", (
+            f"manifest schema {manifest.get('schema_version')!r} "
+            f"(this loader speaks {MANIFEST_SCHEMA_VERSION})"
+        )
+    backend = jax.default_backend()
+    if manifest.get("platform") != backend:
+        return "rejected", (
+            f"platform {manifest.get('platform')!r} != {backend!r}"
+        )
+    if manifest.get("fingerprint") == xla_cache.host_fingerprint():
+        return "match", ""
+    import platform
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "")
+    except Exception:  # noqa: BLE001
+        jaxlib_version = ""
+    if (
+        manifest.get("machine") == platform.machine()
+        and manifest.get("jaxlib") == jaxlib_version
+        and xla_cache.is_cosmetic_feature_diff(
+            manifest.get("cpu_features") or (),
+            xla_cache.host_cpu_features(),
+        )
+    ):
+        return "cosmetic", ""
+    return "rejected", (
+        f"host fingerprint {manifest.get('fingerprint')!r} differs on real "
+        f"ISA features from {xla_cache.host_fingerprint()!r}"
+    )
+
+
+def shipped_index(
+    artifact_dir: str, manifest: Dict[str, Any]
+) -> Dict[str, List[Dict[str, Any]]]:
+    """The manifest's entries grouped by spec_key, each with an absolute
+    ``path`` — the shape ``CrossModelBatcher.load_shipped`` consumes.
+    Entries whose program file is missing are dropped (the manifest lint
+    flags them; the loader just serves without)."""
+    programs_dir = os.path.join(artifact_dir, PROGRAMS_DIR)
+    by_spec: Dict[str, List[Dict[str, Any]]] = {}
+    for entry in manifest.get("programs") or []:
+        if not isinstance(entry, dict):
+            continue
+        path = os.path.join(programs_dir, str(entry.get("file", "")))
+        if not os.path.isfile(path):
+            continue
+        by_spec.setdefault(str(entry.get("spec_key")), []).append(
+            {**entry, "path": path}
+        )
+    return by_spec
+
+
+def deserialize(path: str):
+    """Load one ``.jaxprog`` back into a callable compiled executable.
+    No tracing happens here or when the result is called — that is the
+    entire point."""
+    from jax.experimental import serialize_executable
+
+    with open(path, "rb") as fh:
+        payload, in_tree, out_tree = pickle.load(fh)
+    return serialize_executable.deserialize_and_load(
+        payload, in_tree, out_tree
+    )

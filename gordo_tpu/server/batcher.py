@@ -476,6 +476,17 @@ class CrossModelBatcher:
         # compiled executable never re-traces, so steady state keeps
         # gordo_server_trace_compiles_total flat
         self._aot: Dict[Tuple, Tuple[Tuple, Any]] = {}
+        # how the AOT cache was populated (ISSUE 14): shipped = programs
+        # deserialized from an artifact's programs/ manifest, compiled =
+        # lowered+compiled fresh by prelower, rejected = manifest entries
+        # refused on a real-ISA fingerprint mismatch (warmup counts those
+        # here so the report and /debug/vars agree with the counters);
+        # compile_seconds_saved credits each shipped program with the
+        # compile wall the BUILD host paid for it
+        self.aot_stats = {
+            "shipped": 0, "compiled": 0, "rejected": 0,
+            "compile_seconds_saved": 0.0,
+        }
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
         self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
@@ -527,6 +538,57 @@ class CrossModelBatcher:
             return False
         return bank.replace(old_params, new_params) is not None
 
+    def load_shipped(self, spec, entries) -> int:
+        """Deserialize-first AOT population (ISSUE 14): install an
+        artifact's shipped serving executables straight into ``_aot``
+        without touching trace-time Python — no bank required yet, no
+        trace, no XLA compile. ``entries`` are the manifest rows for this
+        spec (serializer/programs.shipped_index), ALREADY fingerprint-
+        cleared by the caller: this method never sees a rejected
+        manifest. Entries are keyed by their own baked-in capacity —
+        one that doesn't match the bank capacity serving settles on is
+        simply never hit (and prelower compiles the real bucket fresh).
+        Returns how many programs were installed."""
+        from gordo_tpu.serializer import programs as programs_mod
+
+        loaded = 0
+        for entry in entries:
+            try:
+                n_pad = int(entry["n_pad"])
+                b_pad = int(entry["b_pad"])
+                capacity = int(entry["capacity"])
+                x_shape = tuple(int(d) for d in entry["x_shape"])
+            except (KeyError, TypeError, ValueError) as exc:
+                logger.warning("malformed shipped-program entry: %s", exc)
+                continue
+            key = (spec, n_pad, b_pad, capacity)
+            if key in self._aot:
+                continue
+            try:
+                executable = programs_mod.deserialize(entry["path"])
+            except Exception as exc:  # noqa: BLE001 — prelower compiles it
+                logger.warning(
+                    "deserializing shipped program %s failed (will compile "
+                    "fresh instead): %s", entry.get("file"), exc,
+                )
+                continue
+            self._aot[key] = (x_shape, executable)
+            self.aot_stats["shipped"] += 1
+            self.aot_stats["compile_seconds_saved"] += float(
+                entry.get("compile_s") or 0.0
+            )
+            metric_catalog.AOT_PROGRAMS.labels(source="shipped").inc()
+            loaded += 1
+        return loaded
+
+    def note_rejected_shipment(self, count: int) -> None:
+        """Record ``count`` shipped programs refused on a real-ISA
+        fingerprint mismatch (warmup walks the ladder; the batcher owns
+        the stats so one snapshot covers all three sources)."""
+        if count > 0:
+            self.aot_stats["rejected"] += count
+            metric_catalog.AOT_PROGRAMS.labels(source="rejected").inc(count)
+
     def prelower(
         self,
         spec,
@@ -574,12 +636,15 @@ class CrossModelBatcher:
                     jax.ShapeDtypeStruct(x_shape, X_pad.dtype),
                 ).compile()
             except Exception as exc:  # noqa: BLE001 — jit path still serves
+                metric_catalog.PRELOWER_FAILURES.inc()
                 logger.warning(
                     "AOT pre-lower failed for (n_pad=%d, fuse=%d): %s",
                     n_pad, b_pad, exc,
                 )
                 continue
             self._aot[key] = (x_shape, executable)
+            self.aot_stats["compiled"] += 1
+            metric_catalog.AOT_PROGRAMS.labels(source="compiled").inc()
             compiled += 1
         return compiled
 

@@ -119,6 +119,7 @@ def vars_view(config: Dict[str, Any]) -> Response:
         metrics[metric.name] = {"kind": metric.kind, "series": series}
 
     from gordo_tpu.observability import device, shared
+    from gordo_tpu.server import warmup
     from gordo_tpu.server.batcher import peek_batcher
 
     batcher = peek_batcher()
@@ -133,6 +134,10 @@ def vars_view(config: Dict[str, Any]) -> Response:
                 "project": config.get("PROJECT"),
             },
             "batcher": None if batcher is None else dict(batcher.stats),
+            # last warmup report (boot / hot-swap pre-warm / /debug/prewarm):
+            # AOT program counts incl. shipped-vs-compiled and the compile
+            # seconds shipped programs saved — the node's warmth at a glance
+            "warmup": warmup.last_report(),
             # duty cycle / online MFU / param-bank residency / memory
             # (observability/device.py; refreshes the gauges it reports)
             "device": device.snapshot(),

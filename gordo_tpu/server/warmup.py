@@ -32,12 +32,26 @@ already-stacked bank instead of paying a restack in the request path
 
 import logging
 import os
+import threading
 import time
 from typing import Iterable, Optional
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+# the most recent warmup_collection report (any trigger: boot, hot-swap
+# pre-warm, /debug/prewarm) — surfaced on /debug/vars so an operator can
+# read the node's warmth (AOT program counts, compile seconds saved)
+# without grepping logs
+_last_report: Optional[dict] = None
+_last_report_lock = threading.Lock()
+
+
+def last_report() -> Optional[dict]:
+    """The most recent warmup report, or None before any warmup ran."""
+    with _last_report_lock:
+        return None if _last_report is None else dict(_last_report)
 
 
 def _jax_estimators(model):
@@ -59,6 +73,52 @@ def _jax_estimators(model):
             stack.append(node.base_estimator)
         if hasattr(node, "steps"):  # sklearn Pipeline
             stack.extend(step for _name, step in node.steps)
+
+
+def _load_shipped_programs(model, artifact_dir) -> int:
+    """Deserialize-first AOT population (ISSUE 14): when the artifact
+    ships a ``programs/`` manifest and ``GORDO_TPU_LOAD_SHIPPED_PROGRAMS``
+    is on, walk the fingerprint ladder and install every cleared program
+    straight into the batcher's AOT cache — BEFORE the first warmup
+    predict, so even warmup's own traffic runs on the shipped executables
+    instead of paying trace+compile. A manifest rejected on a real-ISA
+    mismatch is counted loudly (``gordo_server_aot_programs_total
+    {source="rejected"}``) and its programs are never executed; serving
+    proceeds on the ordinary compile path. Returns programs installed."""
+    from gordo_tpu.serializer import programs as programs_mod
+    from gordo_tpu.server.batcher import get_batcher
+
+    if not artifact_dir or not programs_mod.load_enabled():
+        return 0
+    batcher = get_batcher()
+    if batcher is None:
+        return 0
+    manifest = programs_mod.load_manifest(artifact_dir)
+    if manifest is None:
+        return 0
+    status, reason = programs_mod.classify_manifest(manifest)
+    if status == "rejected":
+        entries = manifest.get("programs") or []
+        batcher.note_rejected_shipment(len(entries))
+        logger.warning(
+            "rejecting %d shipped AOT program(s) from %s: %s — serving "
+            "falls back to the jit/prelower path",
+            len(entries), artifact_dir, reason,
+        )
+        return 0
+    if status == "cosmetic":
+        logger.info(
+            "loading shipped AOT programs from %s despite a fingerprint "
+            "mismatch: the CPU-feature diff is cosmetic "
+            "(prefer-no-gather-style tuning pseudo-features)", artifact_dir,
+        )
+    by_spec = programs_mod.shipped_index(artifact_dir, manifest)
+    loaded = 0
+    for estimator in _jax_estimators(model):
+        entries = by_spec.get(programs_mod.spec_key(estimator.spec_))
+        if entries:
+            loaded += batcher.load_shipped(estimator.spec_, entries)
+    return loaded
 
 
 def _prelower_programs(model, bucket_rows, offset, n_features) -> int:
@@ -180,6 +240,17 @@ def warmup_collection(
     warmed = 0
     registered = 0
     failed = []
+    # snapshot the batcher's AOT source accounting so the report's
+    # shipped/rejected/seconds-saved keys cover exactly THIS warmup
+    from gordo_tpu.server.batcher import peek_batcher
+
+    def _aot_stats():
+        batcher = peek_batcher()
+        if batcher is None:
+            return {"shipped": 0, "rejected": 0, "compile_seconds_saved": 0.0}
+        return dict(batcher.aot_stats)
+
+    aot_before = _aot_stats()
     for name in names:
         try:
             metadata = load_metadata(collection_dir, name)
@@ -198,6 +269,12 @@ def warmup_collection(
             if n_features == 0:
                 raise ValueError("no tags in metadata")
             model = load_model(collection_dir, name)
+            # deserialize-first (ISSUE 14): install any shipped AOT
+            # executables BEFORE the first predict, so even warmup's own
+            # traffic runs on them instead of paying trace+compile
+            _load_shipped_programs(
+                model, os.path.join(collection_dir, name)
+            )
             for bucket in bucket_rows:
                 # + offset so windowed models produce exactly `bucket`
                 # output rows — the same power-of-two program bucket real
@@ -223,18 +300,34 @@ def warmup_collection(
             logger.warning("warmup failed for model %r: %s", name, exc)
             failed.append(name)
     seconds = time.monotonic() - t0
+    aot_after = _aot_stats()
+    aot_shipped = aot_after["shipped"] - aot_before["shipped"]
+    aot_rejected = aot_after["rejected"] - aot_before["rejected"]
+    saved = (
+        aot_after["compile_seconds_saved"]
+        - aot_before["compile_seconds_saved"]
+    )
     logger.info(
         "serving warmup: %d model(s), %d predict program(s), %d AOT "
-        "pre-lowered fused program(s), %d param-bank registration(s) "
-        "in %.1fs%s",
-        warmed, programs, aot_programs, registered, seconds,
+        "pre-lowered fused program(s), %d shipped AOT program(s) loaded "
+        "(%.1f compile-seconds saved, %d rejected), %d param-bank "
+        "registration(s) in %.1fs%s",
+        warmed, programs, aot_programs, aot_shipped, saved, aot_rejected,
+        registered, seconds,
         f" ({len(failed)} failed: {failed})" if failed else "",
     )
-    return {
+    report = {
         "models": warmed,
         "programs": programs,
         "aot_programs": aot_programs,
+        "aot_shipped": aot_shipped,
+        "aot_rejected": aot_rejected,
+        "compile_seconds_saved": round(saved, 2),
         "registered_params": registered,
         "seconds": round(seconds, 2),
         "failed": failed,
     }
+    global _last_report
+    with _last_report_lock:
+        _last_report = dict(report)
+    return report

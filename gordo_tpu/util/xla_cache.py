@@ -46,6 +46,35 @@ def _feature_sets(message: str):
     return sets
 
 
+def host_cpu_features() -> frozenset:
+    """The host's CPU feature tokens (x86 ``flags`` / arm64 ``Features``
+    from /proc/cpuinfo) — the feature set XLA:CPU AOT code generation keys
+    on, and therefore the set a shipped-program manifest records so a
+    loading host can classify a fingerprint mismatch as cosmetic or real
+    (serializer/programs.py). Empty when /proc/cpuinfo is unreadable."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    _, _, value = line.partition(":")
+                    return frozenset(value.split())
+    except OSError:
+        pass
+    return frozenset()
+
+
+def is_cosmetic_feature_diff(a, b) -> bool:
+    """True when two CPU-feature sets differ ONLY by the cosmetic XLA
+    tuning pseudo-features (``prefer-no-gather``/``prefer-no-scatter``) —
+    the set-level twin of :func:`is_cosmetic_aot_mismatch`, used by the
+    shipped-program loader to accept an artifact whose host fingerprint
+    differs for reasons that cannot SIGILL. An identical pair is cosmetic
+    too (the fingerprint then differed on something outside the feature
+    set, e.g. the processor model string). Any real ISA difference
+    (avx512f, sve, ...) is NOT cosmetic."""
+    return (set(a) ^ set(b)) <= _COSMETIC_FEATURES
+
+
 def is_cosmetic_aot_mismatch(message: str) -> bool:
     """True only when the message is the AOT feature-mismatch warning AND
     every differing feature is a cosmetic tuning pseudo-feature. Parsing

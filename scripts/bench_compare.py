@@ -94,6 +94,13 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # the detect_to_swap gate; a nonzero baseline gates normally)
     ("drift_loop_detect_to_swap_s", False),
     ("drift_loop_dropped_requests", False),
+    # build-to-serve cold start (ISSUE 14): boot wall to the first fused
+    # predict with shipped AOT programs, and the serve-side compile count
+    # in that arm — ~0 by construction, so ANY increase is a regression.
+    # Absent in pre-v5 records, so they only gate once both sides of a
+    # pair carry them.
+    ("cold_start_time_to_first_fused_s", False),
+    ("cold_start_serve_time_compiles", False),
 )
 
 # which harness section feeds each metric (schema v2 records carry a
@@ -115,6 +122,8 @@ def metric_section(key: str, parsed: dict) -> Optional[str]:
         return "fleet_build"
     if key.startswith("drift_loop_"):
         return "drift_loop"
+    if key.startswith("cold_start_"):
+        return "cold_start"
     return None
 
 

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""
+Lint: every shipped-programs artifact manifest conforms to the contract.
+
+The build-to-serve pipeline (ISSUE 14) makes ``<artifact>/programs/`` part
+of the artifact contract: ``manifest.json`` indexes serialized fused
+serving executables plus the builder's host fingerprint, and serving
+nodes decide from the manifest ALONE whether the payloads may load (the
+fingerprint ladder in gordo_tpu/serializer/programs.py). A manifest that
+drifts from that contract fails in the worst place — at cold-node boot,
+silently downgrading to the compile path — so the contract is made
+checkable on the artifacts themselves, the same enforcement pattern as
+the bench-record / metric-name / env-knob lints.
+
+Checked per ``programs/manifest.json`` found under the given roots:
+
+- the manifest parses as a dict with the known ``schema_version``;
+- the host block is complete: non-empty ``fingerprint``, ``platform``
+  and ``machine`` strings, a ``cpu_features`` list and a ``jaxlib`` key
+  (the classifier needs the raw ingredients, not just the hash);
+- ``programs`` is a list of well-formed entries (``file`` with the
+  ``.jaxprog`` suffix, ``spec_key``, integer ``n_pad``/``b_pad``/
+  ``capacity``, an ``x_shape`` list) whose files all exist;
+- no orphans: every ``*.jaxprog`` on disk is indexed by the manifest
+  (an unindexed blob is dead weight the loader will never read).
+
+Usage: ``python scripts/lint_artifact_manifest.py [roots...]`` (default:
+the repo root — build outputs are not checked in, so the default
+invocation is the vacuous-pass tier-1 gate plus a home for operators to
+point at real artifact collections). Exit 0 = all manifests valid (or
+none found), 1 = violations (one per line). Wired into tier-1 via
+tests/gordo_tpu/test_lint.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MANIFEST_SCHEMA_VERSION = 1
+PROGRAM_SUFFIX = ".jaxprog"
+
+_REQUIRED_ENTRY_KEYS = ("file", "spec_key", "n_pad", "b_pad", "capacity")
+_INT_ENTRY_KEYS = ("n_pad", "b_pad", "capacity")
+
+
+def find_manifests(root: str) -> List[str]:
+    """Every ``programs/manifest.json`` under ``root`` (which may itself
+    be an artifact dir, a collection dir, or a whole tree)."""
+    found = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        # never descend into VCS internals; build outputs can be large
+        dirnames[:] = [d for d in dirnames if d != ".git"]
+        if (
+            os.path.basename(dirpath) == "programs"
+            and "manifest.json" in filenames
+        ):
+            found.append(os.path.join(dirpath, "manifest.json"))
+    return sorted(found)
+
+
+def validate_manifest(path: str) -> List[str]:
+    """Violations for one manifest file ([] = valid)."""
+    rel = os.path.relpath(path, REPO_ROOT) if path.startswith(
+        REPO_ROOT
+    ) else path
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"{rel}: unreadable manifest ({exc})"]
+    if not isinstance(manifest, dict):
+        return [f"{rel}: manifest is not a JSON object"]
+
+    violations = []
+    if manifest.get("schema_version") != MANIFEST_SCHEMA_VERSION:
+        violations.append(
+            f"{rel}: schema_version {manifest.get('schema_version')!r} "
+            f"(expected {MANIFEST_SCHEMA_VERSION})"
+        )
+    for key in ("fingerprint", "platform", "machine"):
+        value = manifest.get(key)
+        if not isinstance(value, str) or not value:
+            violations.append(
+                f"{rel}: host field {key!r} missing or empty "
+                f"(got {value!r}) — the loader's fingerprint ladder "
+                f"needs it"
+            )
+    if not isinstance(manifest.get("cpu_features"), list):
+        violations.append(
+            f"{rel}: cpu_features must be a list (the cosmetic-vs-real "
+            f"mismatch classifier consumes it)"
+        )
+    if "jaxlib" not in manifest:
+        violations.append(f"{rel}: jaxlib version key missing")
+
+    entries = manifest.get("programs")
+    if not isinstance(entries, list) or not entries:
+        violations.append(
+            f"{rel}: programs must be a non-empty list (an artifact "
+            f"with nothing to ship has no manifest at all)"
+        )
+        entries = []
+
+    programs_dir = os.path.dirname(path)
+    indexed = set()
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            violations.append(f"{rel}: programs[{i}] is not an object")
+            continue
+        missing = [k for k in _REQUIRED_ENTRY_KEYS if k not in entry]
+        if missing:
+            violations.append(
+                f"{rel}: programs[{i}] missing keys {missing}"
+            )
+            continue
+        fname = str(entry["file"])
+        indexed.add(fname)
+        if not fname.endswith(PROGRAM_SUFFIX):
+            violations.append(
+                f"{rel}: programs[{i}] file {fname!r} lacks the "
+                f"{PROGRAM_SUFFIX} suffix"
+            )
+        if os.path.basename(fname) != fname:
+            violations.append(
+                f"{rel}: programs[{i}] file {fname!r} must be a bare "
+                f"filename inside programs/"
+            )
+        elif not os.path.isfile(os.path.join(programs_dir, fname)):
+            violations.append(
+                f"{rel}: programs[{i}] file {fname!r} does not exist "
+                f"— the loader would silently serve without it"
+            )
+        for key in _INT_ENTRY_KEYS:
+            if not isinstance(entry.get(key), int):
+                violations.append(
+                    f"{rel}: programs[{i}].{key} must be an integer "
+                    f"(got {entry.get(key)!r})"
+                )
+        if "x_shape" in entry and not isinstance(entry["x_shape"], list):
+            violations.append(
+                f"{rel}: programs[{i}].x_shape must be a list"
+            )
+
+    try:
+        on_disk = {
+            f for f in os.listdir(programs_dir)
+            if f.endswith(PROGRAM_SUFFIX)
+        }
+    except OSError:
+        on_disk = set()
+    for orphan in sorted(on_disk - indexed):
+        violations.append(
+            f"{rel}: orphaned program file {orphan!r} not indexed by "
+            f"the manifest — dead weight the loader never reads"
+        )
+    return violations
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument(
+        "roots", nargs="*", default=[REPO_ROOT],
+        help="artifact/collection dirs (or trees) to scan "
+        "(default: the repo root)",
+    )
+    args = parser.parse_args(argv)
+
+    manifests: List[str] = []
+    for root in args.roots:
+        if os.path.isfile(root):
+            manifests.append(root)
+        else:
+            manifests.extend(find_manifests(root))
+
+    violations: List[str] = []
+    for path in manifests:
+        violations.extend(validate_manifest(path))
+    for line in violations:
+        print(line)
+    if not violations:
+        print(f"{len(manifests)} artifact manifest(s) valid")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,10 @@ _NUMERIC_KEYS = (
     # (the zero-downtime claim, gated at 0-regression), models swapped
     "drift_loop_detect_to_swap_s", "drift_loop_dropped_requests",
     "drift_loop_swapped_models",
+    # the build-to-serve cold-start section (ISSUE 14): boot wall to the
+    # first fused predict with shipped AOT programs, and the serve-side
+    # trace-compile count in that arm (the ~0 tentpole claim)
+    "cold_start_time_to_first_fused_s", "cold_start_serve_time_compiles",
 )
 
 
@@ -80,6 +84,8 @@ _FALLBACK_NAMES_BY_VERSION = {
         "fleet_build"],
     4: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build", "drift_loop"],
+    5: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build", "drift_loop", "cold_start"],
 }
 _FALLBACK_STATUSES = [
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",

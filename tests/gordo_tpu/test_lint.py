@@ -21,6 +21,11 @@ Tier-1 lint gates.
   on a renamed metric renders empty silently. Plus a tiny-budget fleet
   scrape smoke holding the merged /metrics exposition to the same
   naming bar.
+- Every shipped-programs artifact manifest conforms to the build-to-serve
+  contract (scripts/lint_artifact_manifest.py): known schema, complete
+  host-fingerprint block, well-formed entries, no missing or orphaned
+  ``.jaxprog`` files — a drifted manifest fails silently at cold-node
+  boot, downgrading to the compile path.
 """
 
 import json
@@ -33,6 +38,7 @@ LINT = REPO_ROOT / "scripts" / "lint_bare_except.py"
 METRIC_LINT = REPO_ROOT / "scripts" / "lint_metric_names.py"
 KNOB_LINT = REPO_ROOT / "scripts" / "lint_env_knobs.py"
 RECORD_LINT = REPO_ROOT / "scripts" / "lint_bench_record.py"
+MANIFEST_LINT = REPO_ROOT / "scripts" / "lint_artifact_manifest.py"
 
 
 def test_no_bare_except_in_gordo_tpu():
@@ -401,6 +407,134 @@ def test_dashboard_lint_grounds_gateway_family(tmp_path):
     assert result.returncode == 1
     assert "gordo_gateway_requests_total" in result.stdout
     assert "gordo_gateway_proxy_seconds" in result.stdout
+
+
+# -------------------------------------------- artifact-manifest lint
+def _run_manifest_lint(*args):
+    return subprocess.run(
+        [sys.executable, str(MANIFEST_LINT), *map(str, args)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+
+
+def _manifest_fixture(tmp_path, mutate=None):
+    """A minimal valid artifact with a shipped-programs manifest; `mutate`
+    edits the manifest dict (and may touch the dir) before writing."""
+    programs_dir = tmp_path / "artifact" / "programs"
+    programs_dir.mkdir(parents=True)
+    fname = "abc123def456-n128-b1-c8.jaxprog"
+    (programs_dir / fname).write_bytes(b"\x80\x04N.")
+    manifest = {
+        "schema_version": 1,
+        "fingerprint": "c94e61e4dfe1",
+        "platform": "cpu",
+        "machine": "x86_64",
+        "cpu_features": ["avx2", "fma"],
+        "jaxlib": "0.4.37",
+        "programs": [
+            {
+                "file": fname,
+                "spec_key": "abc123def456",
+                "n_pad": 128,
+                "b_pad": 1,
+                "capacity": 8,
+                "x_shape": [1, 128, 4],
+                "dtype": "float32",
+                "compile_s": 0.25,
+            }
+        ],
+    }
+    if mutate:
+        mutate(manifest, programs_dir)
+    (programs_dir / "manifest.json").write_text(json.dumps(manifest))
+    return tmp_path / "artifact"
+
+
+def test_manifest_lint_default_invocation_passes():
+    """The bare invocation (what tier-1 runs): build outputs are not
+    checked in, so the repo-root scan finds nothing and passes — and a
+    future round that DOES commit an artifact gets it linted for free."""
+    result = _run_manifest_lint()
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_manifest_lint_accepts_valid_artifact(tmp_path):
+    artifact = _manifest_fixture(tmp_path)
+    result = _run_manifest_lint(artifact)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "1 artifact manifest(s) valid" in result.stdout
+
+
+def test_manifest_lint_flags_missing_fingerprint_and_schema(tmp_path):
+    def mutate(manifest, programs_dir):
+        manifest["fingerprint"] = ""
+        manifest["schema_version"] = 99
+
+    artifact = _manifest_fixture(tmp_path, mutate)
+    result = _run_manifest_lint(artifact)
+    assert result.returncode == 1
+    assert "fingerprint" in result.stdout
+    assert "schema_version" in result.stdout
+
+
+def test_manifest_lint_flags_missing_and_orphaned_files(tmp_path):
+    def mutate(manifest, programs_dir):
+        # indexed but absent on disk
+        manifest["programs"].append(
+            {**manifest["programs"][0], "file": "ghost-n128-b4-c8.jaxprog"}
+        )
+        # on disk but unindexed
+        (programs_dir / "orphan-n1024-b1-c8.jaxprog").write_bytes(b"x")
+
+    artifact = _manifest_fixture(tmp_path, mutate)
+    result = _run_manifest_lint(artifact)
+    assert result.returncode == 1
+    assert "ghost-n128-b4-c8.jaxprog" in result.stdout
+    assert "does not exist" in result.stdout
+    assert "orphan-n1024-b1-c8.jaxprog" in result.stdout
+    assert "orphaned" in result.stdout
+
+
+def test_manifest_lint_real_shipped_artifact_passes(tmp_path, monkeypatch):
+    """Ground truth: a manifest written by the REAL build-side shipper
+    passes the lint — the lint and programs.ship_programs can't drift
+    apart without this failing."""
+    pytest = __import__("pytest")
+    np = __import__("numpy")
+    from gordo_tpu.serializer import programs as programs_mod
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    class _Estimator:
+        pass
+
+    import jax.numpy as jnp
+
+    from gordo_tpu.models.models import AutoEncoder
+
+    spec = AutoEncoder(kind="feedforward_hourglass").build_spec(4, 4)
+    from gordo_tpu.ops.nn import init_model_params
+
+    estimator = _Estimator()
+    estimator.spec_ = spec
+    estimator.params_ = init_model_params(
+        __import__("jax").random.PRNGKey(0), spec
+    )
+    artifact = tmp_path / "artifact"
+    artifact.mkdir()
+    (artifact / "metadata.json").write_text(json.dumps({
+        "dataset": {"tags": ["a", "b", "c", "d"]},
+        "metadata": {"build_metadata": {"model": {"model_offset": 0}}},
+    }))
+    shipped = programs_mod.ship_programs(
+        estimator, str(artifact), expected_fleet=1,
+        bucket_rows=(128,), fuse_widths=(1,),
+    )
+    assert shipped == 1
+    result = _run_manifest_lint(artifact)
+    assert result.returncode == 0, result.stdout + result.stderr
 
 
 def test_fleet_scrape_smoke(tmp_path, monkeypatch):

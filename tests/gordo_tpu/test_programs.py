@@ -1,0 +1,323 @@
+"""
+Build-to-serve compiled-artifact pipeline (ISSUE 14).
+
+The contract under test: a build with ``GORDO_TPU_SHIP_PROGRAMS=1``
+emits AOT-serialized fused serving executables into
+``<artifact>/programs/`` with a host-fingerprinted manifest; serving
+warmup with ``GORDO_TPU_LOAD_SHIPPED_PROGRAMS=1`` deserializes them into
+the batcher's AOT cache before the first predict; and the fingerprint
+ladder guarantees an artifact from a genuinely different host is
+REJECTED loudly (counter + warning, jit fallback, byte-identical
+responses) while a cosmetic ``prefer-no-gather``-style diff still loads.
+The drift loop's hot swap rides the same loader, so a delta revision's
+shipped programs are live before the pointer flips.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from gordo_tpu.builder.build_model import ModelBuilder
+from gordo_tpu.machine import Machine
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.serializer import programs
+from gordo_tpu.server import batcher as batcher_mod
+from gordo_tpu.server import hotswap, warmup
+from gordo_tpu.util import xla_cache
+
+MACHINE_NAME = "prog-pipeline-m0"
+N_TAGS = 4
+
+
+def _machine_config(name):
+    return {
+        "name": name,
+        "dataset": {
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-30 06:00:00Z",
+            "tag_list": [f"tag-{i}" for i in range(N_TAGS)],
+        },
+        "model": {
+            "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.models.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 1,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        },
+        "project_name": "test-programs",
+    }
+
+
+@pytest.fixture(scope="module")
+def shipped_collection(tmp_path_factory):
+    """One artifact built ONCE with program shipping on — the expensive
+    part (train + compile + serialize) shared by every test here. Tests
+    that tamper with the manifest copy the artifact first."""
+    collection = tmp_path_factory.mktemp("shipped") / "rev-1"
+    machine = Machine.from_config(
+        _machine_config(MACHINE_NAME), project_name="test-programs"
+    )
+    os.environ["GORDO_TPU_SHIP_PROGRAMS"] = "1"
+    try:
+        ModelBuilder(machine).build(
+            output_dir=str(collection / MACHINE_NAME)
+        )
+    finally:
+        os.environ.pop("GORDO_TPU_SHIP_PROGRAMS", None)
+    return str(collection)
+
+
+@pytest.fixture
+def fresh_batcher(monkeypatch):
+    """Forced-on, process-fresh batcher (the test_batcher.py pattern)."""
+    monkeypatch.setenv("GORDO_TPU_SERVING_BATCH", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    yield
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+
+
+def _copy_collection(src_collection, tmp_path):
+    dst = tmp_path / "rev-copy"
+    shutil.copytree(src_collection, dst)
+    return str(dst)
+
+
+def _manifest(collection):
+    path = programs.manifest_path(os.path.join(collection, MACHINE_NAME))
+    with open(path) as fh:
+        return json.load(fh), path
+
+
+# ---------------------------------------------------------------- build side
+def test_build_ships_manifest_and_programs(shipped_collection):
+    manifest, path = _manifest(shipped_collection)
+    assert manifest["schema_version"] == programs.MANIFEST_SCHEMA_VERSION
+    assert manifest["fingerprint"] == xla_cache.host_fingerprint()
+    assert manifest["platform"]  # the build's jax backend
+    assert isinstance(manifest["cpu_features"], list)
+    entries = manifest["programs"]
+    # warmup row buckets (128, 1024) x fuse widths (1, 4, 16, 64)
+    assert len(entries) == 8
+    programs_dir = os.path.dirname(path)
+    for entry in entries:
+        assert os.path.isfile(os.path.join(programs_dir, entry["file"]))
+        assert entry["capacity"] == 8  # fleet of 1 -> the bank's floor
+        assert entry["compile_s"] >= 0
+
+
+def test_ship_disabled_by_default(tmp_path):
+    """Without the knob, the build must not grow a programs/ dir — the
+    artifact contract is unchanged for every existing operator."""
+    assert not programs.ship_enabled()
+    machine = Machine.from_config(
+        _machine_config("prog-noship"), project_name="test-programs"
+    )
+    out = tmp_path / "noship" / "prog-noship"
+    ModelBuilder(machine).build(output_dir=str(out))
+    assert not os.path.exists(out / "programs")
+
+
+# ---------------------------------------------------------------- serve side
+def test_warmup_loads_shipped_programs_without_compiling(
+    shipped_collection, fresh_batcher, monkeypatch
+):
+    monkeypatch.setenv("GORDO_TPU_LOAD_SHIPPED_PROGRAMS", "1")
+    report = warmup.warmup_collection(shipped_collection)
+    assert report["failed"] == []
+    assert report["aot_shipped"] == 8
+    assert report["aot_rejected"] == 0
+    assert report["compile_seconds_saved"] > 0
+    # every AOT key came from deserialization; prelower found them all
+    # present and compiled nothing
+    assert report["aot_programs"] == 0
+    batcher = batcher_mod.peek_batcher()
+    assert batcher is not None
+    assert len(batcher._aot) == 8
+    assert batcher.aot_stats["shipped"] == 8
+    assert batcher.aot_stats["compiled"] == 0
+    # the report is surfaced for /debug/vars
+    assert warmup.last_report()["aot_shipped"] == 8
+
+
+def test_load_disabled_by_default_still_prelowers(
+    shipped_collection, fresh_batcher
+):
+    """Knob unset: shipped programs are ignored and warmup compiles its
+    own, exactly as before the pipeline existed."""
+    assert not programs.load_enabled()
+    report = warmup.warmup_collection(shipped_collection)
+    assert report["failed"] == []
+    assert report["aot_shipped"] == 0
+    assert report["aot_programs"] > 0
+    assert batcher_mod.peek_batcher().aot_stats["shipped"] == 0
+
+
+# ------------------------------------------------------- fingerprint ladder
+def test_classify_ladder_schema_platform_and_isa():
+    import jax
+
+    manifest, _ = (
+        {
+            "schema_version": programs.MANIFEST_SCHEMA_VERSION,
+            "fingerprint": xla_cache.host_fingerprint(),
+            "platform": jax.default_backend(),
+            "machine": __import__("platform").machine(),
+            "cpu_features": sorted(xla_cache.host_cpu_features()),
+            "jaxlib": __import__("jaxlib").__version__,
+        },
+        None,
+    )
+    assert programs.classify_manifest(manifest) == ("match", "")
+
+    schema = dict(manifest, schema_version=99)
+    status, reason = programs.classify_manifest(schema)
+    assert status == "rejected" and "schema" in reason
+
+    platform_diff = dict(manifest, platform="tpu")
+    status, reason = programs.classify_manifest(platform_diff)
+    assert status == "rejected" and "platform" in reason
+
+    # fingerprint differs, feature diff is ONLY the XLA tuning
+    # pseudo-features -> cosmetic, loads
+    cosmetic = dict(
+        manifest,
+        fingerprint="0" * 12,
+        cpu_features=sorted(
+            set(manifest["cpu_features"]) ^ {"prefer-no-gather"}
+        ),
+    )
+    assert programs.classify_manifest(cosmetic) == ("cosmetic", "")
+
+    # a real ISA feature differs -> rejected
+    real_isa = dict(
+        manifest,
+        fingerprint="0" * 12,
+        cpu_features=sorted(
+            set(manifest["cpu_features"]) ^ {"avx512_fake_feature"}
+        ),
+    )
+    status, reason = programs.classify_manifest(real_isa)
+    assert status == "rejected" and "ISA" in reason
+
+
+def test_real_isa_mismatch_rejected_at_load_with_jit_fallback(
+    shipped_collection, fresh_batcher, monkeypatch, tmp_path
+):
+    """The tentpole's safety claim: an artifact fingerprinted on a
+    genuinely different host NEVER executes — the loader rejects the
+    whole manifest before touching payload bytes, counts it loudly, and
+    serving falls back to the jit/prelower path with byte-identical
+    responses."""
+    # reference responses from the ordinary compile path
+    monkeypatch.delenv("GORDO_TPU_LOAD_SHIPPED_PROGRAMS", raising=False)
+    warmup.warmup_collection(shipped_collection)
+    from gordo_tpu.server.utils import load_model
+
+    X = np.zeros((100, N_TAGS), np.float32)
+    expected = np.asarray(
+        load_model(shipped_collection, MACHINE_NAME).predict(X)
+    )
+
+    # a copy of the artifact stamped with a different host's fingerprint
+    tampered = _copy_collection(shipped_collection, tmp_path)
+    manifest, path = _manifest(tampered)
+    manifest["fingerprint"] = "deadbeef0000"
+    manifest["cpu_features"] = sorted(
+        set(manifest["cpu_features"]) ^ {"avx512_fake_feature"}
+    )
+    with open(path, "w") as fh:
+        json.dump(manifest, fh)
+
+    monkeypatch.setenv("GORDO_TPU_LOAD_SHIPPED_PROGRAMS", "1")
+    monkeypatch.setattr(batcher_mod, "_batcher", None)
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.evict_machine(MACHINE_NAME)
+    rejected_before = metric_catalog.AOT_PROGRAMS.value(source="rejected")
+    report = warmup.warmup_collection(tampered)
+    assert report["failed"] == []
+    assert report["aot_shipped"] == 0
+    assert report["aot_rejected"] == 8
+    assert (
+        metric_catalog.AOT_PROGRAMS.value(source="rejected")
+        - rejected_before
+    ) == 8
+    batcher = batcher_mod.peek_batcher()
+    assert batcher.aot_stats["shipped"] == 0
+    # the jit/prelower fallback still produced working, identical output
+    actual = np.asarray(load_model(tampered, MACHINE_NAME).predict(X))
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_cosmetic_feature_diff_still_loads(
+    shipped_collection, fresh_batcher, monkeypatch, tmp_path
+):
+    """The round-4 lesson carried over: a fingerprint diff caused ONLY by
+    the XLA tuning pseudo-features (prefer-no-gather/-scatter) cannot
+    SIGILL and must not cost cold-start warmth."""
+    tampered = _copy_collection(shipped_collection, tmp_path)
+    manifest, path = _manifest(tampered)
+    manifest["fingerprint"] = "0" * 12  # no longer matches this host
+    manifest["cpu_features"] = sorted(
+        set(manifest["cpu_features"]) ^ {"prefer-no-gather"}
+    )
+    with open(path, "w") as fh:
+        json.dump(manifest, fh)
+
+    monkeypatch.setenv("GORDO_TPU_LOAD_SHIPPED_PROGRAMS", "1")
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.evict_machine(MACHINE_NAME)
+    report = warmup.warmup_collection(tampered)
+    assert report["failed"] == []
+    assert report["aot_shipped"] == 8
+    assert report["aot_rejected"] == 0
+
+
+# ----------------------------------------------------------- drift hot swap
+def test_hotswap_loads_delta_revisions_shipped_programs(
+    shipped_collection, fresh_batcher, monkeypatch, tmp_path
+):
+    """The drift loop's zero-downtime swap pre-warms through the same
+    loader: a committed delta revision's shipped programs are installed
+    in the batcher's AOT cache by the swap itself."""
+    monkeypatch.setenv("GORDO_TPU_LOAD_SHIPPED_PROGRAMS", "1")
+    # a serving collection + a committed drift revision beside it, both
+    # carrying shipped programs (the rebuild runs with the same env)
+    parent = tmp_path / "serve"
+    collection = parent / "rev-1"
+    shutil.copytree(shipped_collection, collection)
+    rev_dir = parent / f"{hotswap.REVISION_PREFIX}0001"
+    shutil.copytree(shipped_collection, rev_dir)
+    with open(rev_dir / hotswap.COMPLETE_MARKER, "w") as fh:
+        json.dump({"machines": [MACHINE_NAME]}, fh)
+
+    hotswap.reset_for_tests()
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.evict_machine(MACHINE_NAME)
+    try:
+        swapped = hotswap.poll_once(str(collection))
+        assert swapped == [MACHINE_NAME]
+        batcher = batcher_mod.peek_batcher()
+        assert batcher is not None
+        # the swap's pre-warm deserialized the revision's programs
+        assert batcher.aot_stats["shipped"] >= 8
+        assert warmup.last_report()["aot_shipped"] == 8
+    finally:
+        hotswap.reset_for_tests()
+        server_utils.evict_machine(MACHINE_NAME)

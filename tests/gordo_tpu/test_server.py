@@ -402,6 +402,7 @@ def test_warmup_windowed_model_uses_offset(tmp_path):
     result = warmup.warmup_collection(str(tmp_path), bucket_rows=(8,))
     assert result == {
         "models": 1, "programs": 1, "aot_programs": 0,
+        "aot_shipped": 0, "aot_rejected": 0, "compile_seconds_saved": 0.0,
         "registered_params": 0,
         "seconds": result["seconds"], "failed": [],
     }
