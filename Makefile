@@ -56,5 +56,16 @@ lint-bench-records:
 lint-dashboards:
 	python scripts/lint_metric_names.py
 
+# vocabulary + structure check on every committed chaos scenario
+lint-chaos-scenarios:
+	python scripts/lint_chaos_scenario.py
+
+# one real chaos drill against a live 3-node stack: kill a node mid-ramp,
+# assert the availability floor, failover bound and exact histogram merge
+# (see docs/robustness.md "Chaos conductor")
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m gordo_tpu.cli.cli chaos run \
+		resources/chaos/kill_node_mid_ramp.yaml
+
 .PHONY: image push test dryrun smoke render-gate bench bench-gate \
-	lint-bench-records lint-dashboards
+	lint-bench-records lint-dashboards lint-chaos-scenarios chaos-smoke

@@ -1014,24 +1014,26 @@ def _rerun_improves(rerun: dict, original: dict) -> bool:
 # budget pressure can't cost the round its tail-latency record.
 SECTION_NAMES = (
     "tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
-    "fleet_build", "drift_loop", "cold_start",
+    "fleet_build", "drift_loop", "cold_start", "abuse",
 )
 SECTION_STATUSES = (
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",
 )
-RECORD_SCHEMA_VERSION = 5
+RECORD_SCHEMA_VERSION = 6
 # Older records stay valid against the section list of THEIR schema
 # version (the record lint looks the version up here): a v2 record has no
 # fleet_build section and must not start failing when v3 adds one, nor a
-# v3 record when v4 adds drift_loop or a v4 record when v5 adds
-# cold_start.
+# v3 record when v4 adds drift_loop, a v4 record when v5 adds cold_start,
+# or a v5 record when v6 adds abuse.
 SECTION_NAMES_BY_VERSION = {
     2: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab"),
     3: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build"),
     4: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build", "drift_loop"),
-    5: SECTION_NAMES,
+    5: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build", "drift_loop", "cold_start"),
+    6: SECTION_NAMES,
 }
 
 
@@ -1066,6 +1068,7 @@ _SECTION_MIN_USEFUL = {
     "fleet_build": 240,
     "drift_loop": 180,
     "cold_start": 180,
+    "abuse": 120,
 }
 
 
@@ -1118,6 +1121,10 @@ def _section_timeout(name: str) -> int:
     ):
         # one tiny shipped-programs fleet build + two fresh-process boot
         # arms — bounded like the other small sections
+        timeout = min(timeout, 900)
+    if name == "abuse" and "BENCH_SECTION_TIMEOUT_ABUSE" not in os.environ:
+        # one ~10s chaos drill against an in-process fleet (CPU-only by
+        # construction: the chaos nodes hold no models) — bounded tight
         timeout = min(timeout, 900)
     if name == "windowed" and "BENCH_SECTION_TIMEOUT_WINDOWED" not in os.environ:
         # four families (LSTM AE/forecast, Transformer, TCN), each with a
@@ -2039,6 +2046,66 @@ def _bench_cold_start() -> dict:
     }
 
 
+def _bench_abuse() -> dict:
+    """Availability under abuse (ISSUE 16): run the committed
+    ``resources/chaos/bench_abuse.yaml`` drill — a 4x flash crowd
+    colliding with a SIGKILL'd serving node on a 3-node fleet — through
+    the chaos conductor, and report the drill's own machine-checked
+    numbers. The chaos nodes hold no models (membership + breakers +
+    fault sites only), so this section measures the serving fabric's
+    robustness, not the model stack: availability over the exactly-merged
+    response log, the flash-window p99, seconds from kill to the dead
+    shard's first hedged success, and the error burn."""
+    import shutil
+    import tempfile
+
+    from gordo_tpu.chaos import load_scenario, run_scenario
+
+    scenario_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "resources", "chaos", "bench_abuse.yaml",
+    )
+    spec = load_scenario(scenario_path)
+    work_dir = tempfile.mkdtemp(prefix="bench-abuse-")
+    try:
+        report = run_scenario(spec, work_dir)
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    scheduled = report["scheduled"] or 1
+    failed_invariants = [
+        r["check"] for r in report["invariants"] if not r["ok"]
+    ]
+    if not report["ok"]:
+        # a failed invariant is a failed section: the record must not
+        # bank a pretty availability number from a drill that FAILED
+        raise RuntimeError(
+            f"chaos drill '{report['scenario']}' failed invariants "
+            f"{failed_invariants}: "
+            + "; ".join(
+                r["detail"] for r in report["invariants"] if not r["ok"]
+            )
+        )
+    return {
+        # flat-key sources: what bench_compare gates round over round
+        "availability": report["availability"],
+        "flash_p99_ms": report["p99_ms"],
+        "failover_s": report["failover_s"],
+        "error_burn": round(
+            sum(report["errors"].values()) / scheduled, 5
+        ),
+        "scheduled": report["scheduled"],
+        "succeeded": report["succeeded"],
+        "scenario": report["scenario"],
+        "nodes": report["nodes"],
+        "invariants_checked": len(report["invariants"]),
+        "errors": report["errors"],
+        "actions": [
+            {k: a.get(k) for k in ("action", "node", "fired_at")}
+            for a in report["actions"]
+        ],
+    }
+
+
 def _section_child(name: str) -> None:
     """Child entrypoint: resolve a backend the same way main() does, run the
     section, print its ``{"platform", "result"}`` envelope as the last
@@ -2055,6 +2122,7 @@ def _section_child(name: str) -> None:
         "fleet_build": _bench_fleet_build,
         "drift_loop": _bench_drift_loop,
         "cold_start": _bench_cold_start,
+        "abuse": _bench_abuse,
     }
     result = sections[name]()
     envelope = {"platform": jax.devices()[0].platform, "result": result}
@@ -2154,6 +2222,8 @@ def main():
             enabled.remove("drift_loop")
         if os.environ.get("BENCH_COLD_START", "1") == "0":
             enabled.remove("cold_start")
+        if os.environ.get("BENCH_ABUSE", "1") == "0":
+            enabled.remove("abuse")
 
     # every canonical section appears in the record, disabled ones
     # included — "no section unaccounted for" is the schema's core promise
@@ -2309,6 +2379,7 @@ def _emit_record(sections: dict, recovered: list):
     fleet_build = sections.get("fleet_build") or {}
     drift_loop = sections.get("drift_loop") or {}
     cold_start = sections.get("cold_start") or {}
+    abuse = sections.get("abuse") or {}
     head = headline.get("result") or {}
 
     serving = head.get("serving", {})
@@ -2329,7 +2400,7 @@ def _emit_record(sections: dict, recovered: list):
     if not platform:
         for entry in (
             smoke, serving_load, windowed, batch_ab, fleet_build, drift_loop,
-            cold_start,
+            cold_start, abuse,
         ):
             if entry.get("platform"):
                 platform = entry["platform"]
@@ -2349,6 +2420,7 @@ def _emit_record(sections: dict, recovered: list):
         "fleet_build": fleet_build,
         "drift_loop": drift_loop,
         "cold_start": cold_start,
+        "abuse": abuse,
         "platform": platform,
         "warmed": os.environ.get("BENCH_WARM", "1") != "0",
         "sections": {
@@ -2373,6 +2445,7 @@ def _emit_record(sections: dict, recovered: list):
     fb = fleet_build.get("result") or {}
     dl = drift_loop.get("result") or {}
     cs = cold_start.get("result") or {}
+    ab = abuse.get("result") or {}
     smoke_res = smoke.get("result") or {}
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
@@ -2533,6 +2606,21 @@ def _emit_record(sections: dict, recovered: list):
                 "without_serve_time_compiles"
             ),
             "programs_shipped": cs.get("programs_shipped"),
+        },
+        # availability under abuse (ISSUE 16): flat keys so
+        # bench_compare.py gates the chaos drill's availability, flash
+        # p99, failover bound and error burn like any headline metric
+        "abuse_availability": ab.get("availability"),
+        "abuse_flash_p99_ms": ab.get("flash_p99_ms"),
+        "abuse_failover_s": ab.get("failover_s"),
+        "abuse_error_burn": ab.get("error_burn"),
+        "abuse": {
+            "platform": abuse.get("platform"),
+            "scenario": ab.get("scenario"),
+            "scheduled": ab.get("scheduled"),
+            "succeeded": ab.get("succeeded"),
+            "nodes": ab.get("nodes"),
+            "invariants_checked": ab.get("invariants_checked"),
         },
         "detail_file": detail_file,
         # schema v2: every canonical section accounted for with an

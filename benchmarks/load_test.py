@@ -43,7 +43,9 @@ Usage:
 import argparse
 import heapq
 import json
+import math
 import os
+import socket
 import sys
 import threading
 import time
@@ -55,6 +57,10 @@ from gordo_tpu.observability.latency import LatencyHistogram
 # how many slowest-request trace ids each worker retains for the
 # flight-recorder cross-check
 DEFAULT_TOP_SLOW = 5
+
+# schedule shapes build_schedule understands (the chaos conductor and
+# scripts/lint_chaos_scenario.py key on this vocabulary)
+SCHEDULE_SHAPES = ("flat", "diurnal", "flash")
 
 
 def _get_json(url: str):
@@ -123,7 +129,7 @@ class WorkerStats:
     """One worker thread's private accounting — no locks on the hot path;
     merged across workers after the run."""
 
-    def __init__(self, top_slow: int = DEFAULT_TOP_SLOW):
+    def __init__(self, top_slow: int = DEFAULT_TOP_SLOW, keep_log: bool = False):
         self.hist = LatencyHistogram()
         self.phase_hists: dict = {}
         self.errors: list = []
@@ -131,11 +137,18 @@ class WorkerStats:
         self.top_slow = top_slow
         self.requests = 0
         self.warmup_requests = 0
+        # per-request response log for the chaos conductor's invariant
+        # checkers: (intended_offset_s, latency_s, error, key). Off by
+        # default — the plain load paths keep their no-allocation hot loop.
+        self.log: list = [] if keep_log else None
 
     def observe(
         self, latency_s, error, trace_id, phases,
         measured: bool, expected_interval_s=None,
+        offset_s=None, key=None,
     ):
+        if self.log is not None:
+            self.log.append((offset_s, latency_s, error, key))
         if error is not None:
             self.errors.append(error)
             return
@@ -243,6 +256,392 @@ def run_open(
     return stats_list, max(wall, duration, 1e-9)
 
 
+# ------------------------------------------------- shaped open-loop load
+def build_schedule(
+    shape: str, qps: float, duration: float, warmup: float = 0.0,
+    peak: float = 4.0, flash_at: float = None, flash_len: float = 1.0,
+    period: float = None, amp: float = 0.5,
+) -> list:
+    """Arrival offsets (seconds from t0, sorted) for a shaped open-loop
+    schedule. ``flat`` reproduces run_open's ``i/qps`` grid exactly —
+    the shapes are a superset, never a replacement, of the plain open
+    loop:
+
+    - ``flat`` — constant rate, arrival i at ``i/qps``.
+    - ``diurnal`` — a compressed day: rate ``qps * (1 + amp*sin)`` over
+      ``period`` seconds (default: the whole window is one cycle),
+      integrated in closed form so arrival times are exact, not sampled.
+    - ``flash`` — flat base rate plus a flash crowd: an extra ``peak``x
+      burst of evenly spaced arrivals inside ``[flash_at, flash_at +
+      flash_len)`` (default: centered in the measure window).
+
+    Deterministic by construction: same parameters, same schedule."""
+    if qps <= 0:
+        raise ValueError("qps must be > 0")
+    horizon = warmup + duration
+    total = max(1, int(round(horizon * qps)))
+    if shape == "flat":
+        return [i / qps for i in range(total)]
+    if shape == "diurnal":
+        cycle = period or max(horizon, 1e-9)
+        amp = min(max(float(amp), 0.0), 0.95)
+        # cumulative arrivals N(t) = qps*(t - amp*cycle/(2pi)*(cos(2pi
+        # t/cycle) - 1)); invert per-arrival by bisection on the strictly
+        # increasing N(t) — exact to float precision, no rate sampling
+        two_pi = 2.0 * math.pi
+
+        def cum(t: float) -> float:
+            return qps * (
+                t - amp * cycle / two_pi * (math.cos(two_pi * t / cycle) - 1.0)
+            )
+
+        total = max(1, int(round(cum(horizon))))
+        offsets = []
+        for i in range(total):
+            lo, hi = 0.0, horizon
+            for _ in range(60):  # < 1ns resolution over any sane horizon
+                mid = (lo + hi) / 2.0
+                if cum(mid) < i:
+                    lo = mid
+                else:
+                    hi = mid
+            offsets.append((lo + hi) / 2.0)
+        return offsets
+    if shape == "flash":
+        base = [i / qps for i in range(total)]
+        if flash_at is None:
+            flash_at = warmup + duration / 2.0 - flash_len / 2.0
+        flash_at = max(0.0, min(flash_at, horizon - 1e-9))
+        flash_len = max(min(flash_len, horizon - flash_at), 1e-9)
+        burst_n = max(1, int(round(flash_len * qps * (peak - 1.0))))
+        burst = [flash_at + j * flash_len / burst_n for j in range(burst_n)]
+        return sorted(base + burst)
+    raise ValueError(f"unknown schedule shape {shape!r} (one of {SCHEDULE_SHAPES})")
+
+
+def skewed_key_picker(keys, hot_pct: float = 0.0, seed: int = 0):
+    """Deterministic per-arrival key selection with optional hot-key skew:
+    ``hot_pct`` percent of arrivals hit one 'hot' key (chosen by seed),
+    the rest round-robin the full set — a fixed pattern (Knuth
+    multiplicative hash of the arrival index), NOT randomness, so two
+    runs of the same scenario target identical keys."""
+    keys = list(keys)
+    if not keys:
+        raise ValueError("need at least one key")
+    hot = keys[seed % len(keys)]
+
+    def pick(i: int):
+        if hot_pct > 0 and ((i * 2654435761 + seed) >> 7) % 100 < hot_pct:
+            return hot
+        return keys[i % len(keys)]
+
+    return pick
+
+
+def run_open_schedule(
+    send, users: int, schedule, first_measured: int = 0,
+    top_slow: int = DEFAULT_TOP_SLOW, keep_log: bool = False,
+    key_of=None, stride=None, t0: float = None, stop=None,
+):
+    """Open-loop load over an EXPLICIT arrival schedule (offsets from t0).
+
+    The generalized form of ``run_open``: same coordinated-omission-safe
+    accounting (latency measured from the intended send time), but the
+    schedule is a first-class argument so shaped loads (build_schedule),
+    hot-key skew (``key_of(i)`` picks the target; send must then accept
+    the key), shard slicing (``stride=(k, n)`` owns arrival indices
+    ``i ≡ k mod n``), and a shared cross-process ``t0`` all compose.
+    ``stop`` (a threading.Event) abandons unsent arrivals early."""
+    stats_list = [WorkerStats(top_slow, keep_log) for _ in range(users)]
+    if t0 is None:
+        t0 = time.monotonic()
+    lock = threading.Lock()
+    cursor = [0]
+    k, n = stride or (0, 1)
+    slots = len(range(k, len(schedule), n))
+
+    def worker(stats):
+        while True:
+            with lock:
+                j = cursor[0]
+                cursor[0] += 1
+            if j >= slots or (stop is not None and stop.is_set()):
+                return
+            i = k + j * n
+            offset = schedule[i]
+            intended = t0 + offset
+            now = time.monotonic()
+            if intended > now:
+                time.sleep(intended - now)
+            if key_of is not None:
+                error, trace_id, phases = send(key_of(i))
+            else:
+                error, trace_id, phases = send()
+            latency = time.monotonic() - intended
+            stats.observe(
+                latency, error, trace_id, phases,
+                measured=i >= first_measured, offset_s=offset, key=(
+                    key_of(i) if key_of is not None else None
+                ),
+            )
+
+    _run_threads(worker, stats_list)
+    horizon = schedule[first_measured] if first_measured < len(schedule) else 0.0
+    wall = time.monotonic() - (t0 + horizon)
+    return stats_list, max(wall, 1e-9)
+
+
+# -------------------------------------------- filesystem shard leasing
+# The same lease idiom as parallel/scheduler.py and server/membership.py:
+# a shard is claimed by O_CREAT|O_EXCL on its lease file, so N workers
+# started independently (processes, hosts on a shared filesystem) split
+# one global schedule with no coordinator and no double-sends. Results
+# are one JSON file per shard; the merge is exact because the
+# log-bucketed histograms add bucket counts (LatencyHistogram.merged).
+def lease_shard(shard_dir: str, shards: int, owner: str):
+    """Claim the lowest unclaimed shard index, or None when all taken."""
+    os.makedirs(shard_dir, exist_ok=True)
+    for k in range(shards):
+        path = os.path.join(shard_dir, f"shard-{k:04d}.lease")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            continue
+        with os.fdopen(fd, "w") as fh:
+            fh.write(json.dumps({"owner": owner, "shard": k}))
+        return k
+    return None
+
+
+def shared_t0(shard_dir: str, lead: float = 0.5) -> float:
+    """One schedule origin for every worker on this host: the first
+    claimer writes ``t0`` (CLOCK_MONOTONIC + lead, system-wide on Linux)
+    via O_EXCL + rename; everyone else reads it back."""
+    path = os.path.join(shard_dir, "t0.json")
+    try:
+        fd = os.open(path + ".claim", os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        with os.fdopen(fd, "w") as fh:
+            fh.write(json.dumps({"t0": time.monotonic() + lead}))
+        os.rename(path + ".claim", path)
+    except FileExistsError:
+        pass
+    deadline = time.monotonic() + 10.0
+    while True:
+        try:
+            with open(path) as fh:
+                return float(json.load(fh)["t0"])
+        except (OSError, ValueError, KeyError):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"no shard t0 under {shard_dir}")
+            time.sleep(0.01)
+
+
+def run_open_sharded(
+    send, users: int, schedule, shards: int, shard_dir: str,
+    first_measured: int = 0, owner: str = None,
+    top_slow: int = DEFAULT_TOP_SLOW, keep_log: bool = False, key_of=None,
+):
+    """Worker half of the sharded open loop: claim shards until none are
+    left, drive each claimed shard's stride slice of the global schedule,
+    and write one result file per shard. Returns the claimed shard ids."""
+    owner = owner or f"{socket.gethostname()}-{os.getpid()}"
+    t0 = shared_t0(shard_dir)
+    claimed = []
+    while True:
+        k = lease_shard(shard_dir, shards, owner)
+        if k is None:
+            return claimed
+        stats_list, wall = run_open_schedule(
+            send, users, schedule, first_measured, top_slow, keep_log,
+            key_of=key_of, stride=(k, shards), t0=t0,
+        )
+        doc = {
+            "shard": k,
+            "owner": owner,
+            "wall": wall,
+            "workers": [_stats_to_dict(s) for s in stats_list],
+        }
+        tmp = os.path.join(shard_dir, f"shard-{k:04d}.result.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, os.path.join(shard_dir, f"shard-{k:04d}.result.json"))
+        claimed.append(k)
+
+
+def merge_shard_results(
+    shard_dir: str, shards: int, timeout: float = 60.0,
+    top_slow: int = DEFAULT_TOP_SLOW,
+):
+    """Collect every shard's result file and merge exactly. Returns
+    ``(stats_list, wall, missing)`` — missing is the list of shard ids
+    whose workers never reported (a crashed worker loses only its own
+    shards; the merge stays exact over what arrived)."""
+    deadline = time.monotonic() + timeout
+    pending = set(range(shards))
+    stats_list, wall = [], 0.0
+    while pending and time.monotonic() < deadline:
+        for k in sorted(pending):
+            path = os.path.join(shard_dir, f"shard-{k:04d}.result.json")
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            stats_list.extend(
+                _stats_from_dict(w, top_slow) for w in doc.get("workers", [])
+            )
+            wall = max(wall, float(doc.get("wall", 0.0)))
+            pending.discard(k)
+        if pending:
+            time.sleep(0.05)
+    return stats_list, max(wall, 1e-9), sorted(pending)
+
+
+# ---------------------------------------------- abuse / chaff connections
+def run_chaff(
+    host: str, port: int, kind: str, conns: int, duration: float,
+    stop=None,
+):
+    """Abuse-shaped connections that are NOT requests: these never count
+    toward availability (the invariant checkers exclude them by
+    construction — they are reported in their own block).
+
+    - ``slow_loris`` — open a connection, dribble one header byte per
+      ~250ms, never finishing the request: ties up per-connection parser
+      state until the server's idle/header timeout closes it.
+    - ``scanner`` — junk-path probes (the background radiation of any
+      exposed port): each expects a fast 4xx and a surviving server.
+
+    Returns counts: opened / server_closed / responses / errors."""
+    report = {"kind": kind, "conns": conns, "opened": 0,
+              "server_closed": 0, "responses": 0, "errors": 0}
+    lock = threading.Lock()
+    paths = ("/admin.php", "/.env", "/wp-login.php", "/cgi-bin/test",
+             "/etc/passwd", "/robots.txt.bak")
+    stop_at = time.monotonic() + duration
+
+    def loris():
+        try:
+            with socket.create_connection((host, port), timeout=5) as sock:
+                with lock:
+                    report["opened"] += 1
+                sock.sendall(b"GET / HTTP/1.1\r\nHost: chaff\r\nX-Dribble: ")
+                sock.settimeout(0.25)
+                while time.monotonic() < stop_at:
+                    if stop is not None and stop.is_set():
+                        return
+                    try:
+                        sock.sendall(b"z")
+                    except OSError:
+                        with lock:
+                            report["server_closed"] += 1
+                        return
+                    try:
+                        if sock.recv(256) == b"":
+                            with lock:
+                                report["server_closed"] += 1
+                            return
+                    except socket.timeout:
+                        pass
+                    except OSError:
+                        with lock:
+                            report["server_closed"] += 1
+                        return
+        except OSError:
+            with lock:
+                report["errors"] += 1
+
+    def scanner(idx: int):
+        i = 0
+        while time.monotonic() < stop_at:
+            if stop is not None and stop.is_set():
+                return
+            path = paths[(idx + i) % len(paths)]
+            i += 1
+            try:
+                with socket.create_connection((host, port), timeout=5) as sock:
+                    with lock:
+                        report["opened"] += 1
+                    sock.sendall(
+                        f"GET {path} HTTP/1.1\r\nHost: chaff\r\n"
+                        f"Connection: close\r\n\r\n".encode()
+                    )
+                    sock.settimeout(5)
+                    if sock.recv(512):
+                        with lock:
+                            report["responses"] += 1
+            except OSError:
+                with lock:
+                    report["errors"] += 1
+            time.sleep(0.1)
+
+    threads = [
+        threading.Thread(
+            target=(loris if kind == "slow_loris" else scanner),
+            args=(() if kind == "slow_loris" else (i,)),
+            daemon=True,
+        )
+        for i in range(conns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return report
+
+
+def pipelined_burst(
+    host: str, port: int, path: str, burst: int = 4, rounds: int = 1,
+    timeout: float = 10.0,
+):
+    """HTTP/1.1 pipelining probe: write ``burst`` GETs back-to-back on ONE
+    connection, then read all the responses — the server must answer
+    them in order without interleaving bodies (the event-loop front end's
+    pipelining contract). Returns per-round status counts + wall."""
+    report = {"burst": burst, "rounds": rounds, "responses": 0,
+              "ok": 0, "errors": 0, "wall_s": 0.0}
+    t_start = time.monotonic()
+    try:
+        with socket.create_connection((host, port), timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            request = (
+                f"GET {path} HTTP/1.1\r\nHost: burst\r\n\r\n".encode()
+            )
+            buffered = b""
+            for _ in range(rounds):
+                sock.sendall(request * burst)
+                seen = 0
+                while seen < burst:
+                    idx = buffered.find(b"\r\n\r\n")
+                    if idx < 0:
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            report["errors"] += burst - seen
+                            raise OSError("server closed mid-pipeline")
+                        buffered += chunk
+                        continue
+                    head, buffered = buffered[:idx + 4], buffered[idx + 4:]
+                    status = head.split(b" ", 2)[1:2]
+                    length = 0
+                    for line in head.split(b"\r\n"):
+                        if line.lower().startswith(b"content-length:"):
+                            length = int(line.split(b":", 1)[1])
+                    while len(buffered) < length:
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            raise OSError("server closed mid-body")
+                        buffered += chunk
+                    buffered = buffered[length:]
+                    seen += 1
+                    report["responses"] += 1
+                    if status and status[0].startswith(b"2"):
+                        report["ok"] += 1
+    except OSError as exc:
+        report["error"] = repr(exc)[:160]
+    report["wall_s"] = round(time.monotonic() - t_start, 4)
+    return report
+
+
 # --------------------------------------------- multi-process open loop
 def _stats_to_dict(stats: WorkerStats) -> dict:
     """JSON-safe snapshot of one worker's accounting for the pipe back to
@@ -256,6 +655,7 @@ def _stats_to_dict(stats: WorkerStats) -> dict:
         "slowest": stats.slowest,
         "requests": stats.requests,
         "warmup_requests": stats.warmup_requests,
+        "log": stats.log,
     }
 
 
@@ -272,6 +672,8 @@ def _stats_from_dict(payload: dict, top_slow: int = DEFAULT_TOP_SLOW):
     stats.slowest = [tuple(item) for item in payload.get("slowest", [])]
     stats.requests = int(payload.get("requests", 0))
     stats.warmup_requests = int(payload.get("warmup_requests", 0))
+    if payload.get("log") is not None:
+        stats.log = [tuple(entry) for entry in payload["log"]]
     return stats
 
 
@@ -496,7 +898,9 @@ def run(
     qps: float = None, ramp_users=None, samples: int = 100,
     codec: str = None, expected_interval_ms: float = None,
     flight: bool = True, top_slow: int = DEFAULT_TOP_SLOW,
-    processes: int = 1, _send=None,
+    processes: int = 1, shape: str = "flat", peak: float = 4.0,
+    flash_at: float = None, flash_len: float = 1.0,
+    shard_dir: str = None, shards: int = 0, _send=None,
 ) -> dict:
     """One full load run against a live server; returns the report dict.
     ``_send`` injects a fake transport for tests."""
@@ -531,6 +935,51 @@ def run(
     if mode == "qps":
         if not qps or qps <= 0:
             return {"error": "--mode qps requires --qps > 0"}
+        if shard_dir and shards > 0:
+            # sharded worker: claim shards of the global shaped schedule
+            # via filesystem leases, write per-shard results, and (when
+            # this worker drained the last shard) merge everything
+            schedule = build_schedule(
+                shape, qps, duration, warmup, peak, flash_at, flash_len
+            )
+            first_measured = int(round(warmup * qps)) if shape == "flat" else (
+                sum(1 for o in schedule if o < warmup)
+            )
+            claimed = run_open_sharded(
+                send, users, schedule, shards, shard_dir,
+                first_measured, top_slow=top_slow,
+            )
+            report.update({
+                "qps_target": qps, "shape": shape, "shards": shards,
+                "claimed_shards": claimed,
+            })
+            stats_list, wall, missing = merge_shard_results(
+                shard_dir, shards, timeout=warmup + duration + 60.0,
+                top_slow=top_slow,
+            )
+            report["missing_shards"] = missing
+            report["scheduled"] = len(schedule) - first_measured
+            report.update(summarize(stats_list, wall, samples, top_slow))
+            all_slowest = report["slowest"]
+            if flight and _send is None:
+                report["flight"] = fetch_worst_traces(host, all_slowest)
+            return report
+        if shape != "flat":
+            schedule = build_schedule(
+                shape, qps, duration, warmup, peak, flash_at, flash_len
+            )
+            first_measured = sum(1 for o in schedule if o < warmup)
+            stats_list, wall = run_open_schedule(
+                send, users, schedule, first_measured, top_slow
+            )
+            report["qps_target"] = qps
+            report["shape"] = shape
+            report["scheduled"] = len(schedule) - first_measured
+            report.update(summarize(stats_list, wall, samples, top_slow))
+            all_slowest = report["slowest"]
+            if flight and _send is None:
+                report["flight"] = fetch_worst_traces(host, all_slowest)
+            return report
         if processes > 1:
             stats_list, wall = run_open_processes(
                 send, users, qps, duration, warmup, processes, top_slow
@@ -603,6 +1052,35 @@ def main(argv=None) -> int:
         "--ramp-users", default="1,2,4,8",
         help="comma-separated concurrency steps for --mode ramp",
     )
+    parser.add_argument(
+        "--shape", choices=SCHEDULE_SHAPES, default="flat",
+        help="open-loop schedule shape for --mode qps: 'flat' (the legacy "
+        "i/qps grid, default), 'diurnal' (sinusoidal compressed day), "
+        "'flash' (flat base + a peak-x flash crowd)",
+    )
+    parser.add_argument(
+        "--peak", type=float, default=4.0,
+        help="flash shape: flash-crowd multiplier over the base rate",
+    )
+    parser.add_argument(
+        "--flash-at", type=float, default=None,
+        help="flash shape: burst start offset seconds (default: centered)",
+    )
+    parser.add_argument(
+        "--flash-len", type=float, default=1.0,
+        help="flash shape: burst length seconds",
+    )
+    parser.add_argument(
+        "--shard-dir", default=None,
+        help="shared directory for multi-worker shard leasing: workers "
+        "started independently claim schedule shards via O_EXCL lease "
+        "files (the scheduler/membership idiom) and merge their "
+        "log-bucketed histograms exactly — requires --shards",
+    )
+    parser.add_argument(
+        "--shards", type=int, default=0,
+        help="total shard count the global schedule is sliced into",
+    )
     parser.add_argument("--samples", type=int, default=100)
     parser.add_argument(
         "--expected-interval-ms", type=float, default=None,
@@ -638,7 +1116,9 @@ def main(argv=None) -> int:
         samples=args.samples, codec=args.codec,
         expected_interval_ms=args.expected_interval_ms,
         flight=not args.no_flight, top_slow=args.top_slow,
-        processes=args.processes,
+        processes=args.processes, shape=args.shape, peak=args.peak,
+        flash_at=args.flash_at, flash_len=args.flash_len,
+        shard_dir=args.shard_dir, shards=args.shards,
     )
     print(json.dumps(report))
     if "error" in report:

@@ -1,0 +1,31 @@
+"""
+Chaos conductor: declarative failure-drill scenarios against a real
+gateway + fleet serving stack.
+
+A scenario file (YAML or JSON, see resources/chaos/) describes one
+drill: the stack to spin up (N serving nodes + one gateway), a timeline
+of shaped load phases (benchmarks/load_test.py schedules), fault actions
+fired at offsets into the run (kill/SIGSTOP a node, expire or corrupt a
+membership lease, plus any ``GORDO_TPU_FAULT_PLAN`` rule for the
+in-process fault sites), and machine-checked invariants evaluated from
+the merged response log and telemetry afterwards.
+
+The pieces:
+
+- :mod:`gordo_tpu.chaos.scenario` — the schema, vocabulary, and parser;
+- :mod:`gordo_tpu.chaos.node` — the serving-node subprocess
+  (``python -m gordo_tpu.chaos.node``): membership lease + per-model
+  circuit breakers + the serving fault sites, no model stack, so kills
+  and stops are real OS signals against a real lease-holder;
+- :mod:`gordo_tpu.chaos.stack` — spins the fleet up and aims actions;
+- :mod:`gordo_tpu.chaos.invariants` — the checkers;
+- :mod:`gordo_tpu.chaos.conductor` — runs the timeline and writes the
+  report. CLI: ``gordo chaos run <scenario>``.
+
+Everything here is import-light (no jax, no model stack) and every knob
+defaults off: importing or not running a scenario changes nothing about
+serving or the load harness.
+"""
+
+from gordo_tpu.chaos.scenario import Scenario, load_scenario  # noqa: F401
+from gordo_tpu.chaos.conductor import run_scenario  # noqa: F401

@@ -1,0 +1,323 @@
+"""
+Chaos conductor: run one scenario end to end and report.
+
+Flow (one ``run_scenario`` call):
+
+1. resolve the environment the drill runs under — membership knobs from
+   the stack block, ``GORDO_TPU_GATEWAY_*`` knobs from its gateway
+   block, scenario ``env`` verbatim, and the scenario's fault plan as
+   ``GORDO_TPU_FAULT_PLAN`` — applied to this process (the in-process
+   gateway reads them) and inherited by the node subprocesses; every
+   touched variable is restored afterwards, so a drill leaves the
+   process as it found it;
+2. spin up the stack (gordo_tpu/chaos/stack.py) and snapshot each
+   machine's ring primary;
+3. drive the load phases back to back on one shared ``t0`` — shaped
+   schedules from benchmarks/load_test.py with per-request logging on,
+   chaff connections beside them — while the timeline thread fires the
+   fault actions at their offsets and the optional drift burst races
+   T threads of enqueues against the queue's O_EXCL exactly-once
+   contract;
+4. merge the accounting exactly (log-bucketed histograms add), collect
+   each reachable node's breaker states, and evaluate the invariants;
+5. return the report; ``ok`` is the AND of every invariant.
+
+Determinism: the schedule, the key pattern (skewed_key_picker), and the
+in-process fault rules all derive from the scenario (seed included) —
+two runs of the same file fire the same faults at the same arrivals.
+Wall-clock effects (exact failover seconds) vary; the invariants bound
+them instead of pinning them.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from gordo_tpu.chaos.invariants import RunContext, evaluate
+from gordo_tpu.chaos.scenario import Scenario
+from gordo_tpu.chaos.stack import ChaosStack
+from gordo_tpu.observability import metrics as metric_catalog
+from gordo_tpu.observability.latency import LatencyHistogram
+from gordo_tpu.server import membership
+from gordo_tpu.util import faults
+
+logger = logging.getLogger(__name__)
+
+
+def _resolved_env(spec: Scenario, directory: str) -> Dict[str, str]:
+    env = {
+        membership.GATEWAY_DIR_ENV: directory,
+        membership.LEASE_TIMEOUT_ENV: str(spec.lease_timeout_s),
+        membership.HEARTBEAT_ENV: str(spec.heartbeat_s),
+    }
+    for key, value in spec.gateway_env.items():
+        env[f"GORDO_TPU_GATEWAY_{key.upper()}"] = value
+    env.update(spec.env)
+    if spec.fault_plan is not None:
+        env[faults.PLAN_ENV] = json.dumps(spec.fault_plan)
+    return env
+
+
+class _EnvScope:
+    """Apply a dict to os.environ, restore every touched key on exit."""
+
+    def __init__(self, env: Dict[str, str]):
+        self.env = env
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        for key, value in self.env.items():
+            self._saved[key] = os.environ.get(key)
+            os.environ[key] = value
+        faults.reset_plan()
+        return self
+
+    def __exit__(self, *exc_info):
+        for key, old in self._saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+        faults.reset_plan()
+
+
+def _gateway_send(port: int):
+    """send(machine) for the load loop: one GET through the gateway,
+    returning load_test's (error, trace_id, phases) contract with the
+    status encoded as ``http-<code>`` on non-2xx."""
+    import http.client
+
+    def send(machine: str):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("GET", f"/gordo/v0/chaos/{machine}/prediction")
+            resp = conn.getresponse()
+            resp.read()
+            if 200 <= resp.status < 300:
+                return None, resp.headers.get("X-Gordo-Gateway-Node"), {}
+            return f"http-{resp.status}", None, {}
+        except OSError as exc:
+            return repr(exc)[:80], None, {}
+        finally:
+            conn.close()
+
+    return send
+
+
+def _run_timeline(spec: Scenario, stack: ChaosStack, t0: float,
+                  fired: List[dict], stop: threading.Event) -> None:
+    for action in spec.timeline:
+        while True:
+            delay = (t0 + action.at) - time.monotonic()
+            if delay <= 0:
+                break
+            if stop.wait(min(delay, 0.1)):
+                return
+        record = {"action": action.action, "at": action.at, "node": action.node}
+        try:
+            if action.action == "set_fault_plan":
+                os.environ[faults.PLAN_ENV] = json.dumps(action.plan)
+                faults.reset_plan()
+            elif action.action == "drop_gateway_conns":
+                stack.drop_gateway_conns()
+            else:
+                record["node_id"] = stack.nodes[action.node].node_id
+                getattr(stack, action.action)(action.node)
+        except Exception as exc:  # noqa: BLE001 — a failed action is reported, not fatal
+            record["error"] = repr(exc)[:160]
+            logger.exception("chaos action %s failed", action.action)
+        record["fired_at"] = time.monotonic() - t0
+        metric_catalog.CHAOS_ACTIONS.labels(action=action.action).inc()
+        logger.info("chaos: fired %s (node=%s) at +%.2fs",
+                    action.action, action.node, record["fired_at"])
+        fired.append(record)
+
+
+def _run_drift_burst(spec: Scenario, directory: str, t0: float,
+                     result: dict) -> None:
+    """T threads all enqueue a rebuild for every drifted machine at once:
+    the queue's O_EXCL ticket files must admit exactly one per machine."""
+    from gordo_tpu.parallel import drift_queue
+
+    drift = spec.drift or {}
+    machines = [f"drifted-{i:02d}" for i in range(int(drift.get("machines", 4)))]
+    threads_n = int(drift.get("threads", 8))
+    queue_dir = os.path.join(directory, "drift-queue")
+    delay = (t0 + float(drift.get("at", 0.0))) - time.monotonic()
+    if delay > 0:
+        time.sleep(delay)
+    wins = [0] * threads_n
+
+    def enqueuer(slot: int):
+        for machine in machines:
+            if drift_queue.enqueue(queue_dir, machine,
+                                   {"reason": "chaos-drill"}):
+                wins[slot] += 1
+
+    workers = [threading.Thread(target=enqueuer, args=(i,), daemon=True)
+               for i in range(threads_n)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    result.update({
+        "machines": len(machines),
+        "threads": threads_n,
+        "enqueued": sum(wins),
+        "depth": drift_queue.depth(queue_dir),
+    })
+
+
+def run_scenario(spec: Scenario, directory: str,
+                 stack_timeout: float = 30.0) -> dict:
+    """Run one parsed scenario under ``directory`` (membership dir, drift
+    queue, scratch). Returns the report dict; ``report["ok"]`` is the
+    verdict."""
+    from benchmarks import load_test
+
+    os.makedirs(directory, exist_ok=True)
+    env = _resolved_env(spec, directory)
+    report: dict = {"scenario": spec.name, "description": spec.description,
+                    "nodes": spec.nodes, "machines": len(spec.machines)}
+    with _EnvScope(env):
+        with ChaosStack(directory, spec.nodes, child_env=env) as stack:
+            stack.start(timeout=stack_timeout)
+            primaries = {
+                m: stack.gateway.ring.candidates(m)[0] for m in spec.machines
+            }
+            send = _gateway_send(stack.gateway_port)
+
+            # schedules first: global offsets, phases back to back
+            schedules, start = [], 0.0
+            for phase in spec.phases:
+                offsets = load_test.build_schedule(
+                    phase.shape, phase.qps, phase.duration,
+                    warmup=phase.warmup, peak=phase.peak,
+                    flash_at=phase.flash_at, flash_len=phase.flash_len,
+                    period=phase.period, amp=phase.amp,
+                )
+                schedules.append([start + o for o in offsets])
+                start += phase.warmup + phase.duration
+            horizon = start
+
+            t0 = time.monotonic() + 0.25
+            stop = threading.Event()
+            fired: List[dict] = []
+            timeline_thread = threading.Thread(
+                target=_run_timeline, args=(spec, stack, t0, fired, stop),
+                daemon=True,
+            )
+            timeline_thread.start()
+
+            chaff_results: List[dict] = []
+            chaff_threads = []
+            for chaff in spec.chaff:
+                def chaff_worker(spec_c=chaff):
+                    chaff_results.append(load_test.run_chaff(
+                        "127.0.0.1", stack.gateway_port, spec_c["kind"],
+                        int(spec_c.get("conns", 2)),
+                        float(spec_c.get("duration", horizon)), stop=stop,
+                    ))
+                t = threading.Thread(target=chaff_worker, daemon=True)
+                t.start()
+                chaff_threads.append(t)
+
+            drift_result: dict = {}
+            drift_thread = None
+            if spec.drift is not None:
+                drift_thread = threading.Thread(
+                    target=_run_drift_burst,
+                    args=(spec, directory, t0, drift_result), daemon=True,
+                )
+                drift_thread.start()
+
+            # the measured load, phase by phase on the one shared t0
+            log: List[tuple] = []
+            scheduled: Dict[int, int] = {}
+            all_stats, per_phase = [], {}
+            for idx, (phase, schedule) in enumerate(zip(spec.phases, schedules)):
+                key_of = load_test.skewed_key_picker(
+                    spec.machines, hot_pct=phase.hot_pct, seed=spec.seed,
+                )
+                stats_list, _wall = load_test.run_open_schedule(
+                    send, phase.users, schedule, keep_log=True,
+                    key_of=key_of, t0=t0,
+                )
+                scheduled[idx] = len(schedule)
+                per_phase[idx] = LatencyHistogram.merged(
+                    s.hist for s in stats_list
+                )
+                all_stats.extend(stats_list)
+                for stats in stats_list:
+                    log.extend(e + (idx,) for e in stats.log)
+
+            stop.set()
+            timeline_thread.join(timeout=10.0)
+            for t in chaff_threads:
+                t.join(timeout=10.0)
+            if drift_thread is not None:
+                drift_thread.join(timeout=30.0)
+
+            breakers = {}
+            for i in range(spec.nodes):
+                states = stack.node_breakers(i)
+                if states is not None:
+                    breakers[stack.nodes[i].node_id] = states
+
+            merged = LatencyHistogram.merged(s.hist for s in all_stats)
+            ctx = RunContext(
+                log=sorted(log, key=lambda e: e[0]),
+                hist=merged,
+                per_phase=per_phase,
+                scheduled=scheduled,
+                primaries=primaries,
+                actions=fired,
+                breakers=breakers,
+                drift=drift_result or None,
+            )
+            results = evaluate(spec.invariants, ctx)
+
+    # ---------------------------------------------------------- reporting
+    total = sum(scheduled.values())
+    ok_n = sum(1 for e in log if e[2] is None)
+    availability = ok_n / total if total else 0.0
+    metric_catalog.CHAOS_AVAILABILITY.set(availability)
+    failover_s = None
+    for res in results:
+        if not res["ok"]:
+            metric_catalog.CHAOS_INVARIANT_FAILURES.labels(
+                invariant=res["check"]
+            ).inc()
+    kill = next((a for a in fired if a["action"] in ("kill_node", "stop_node")
+                 and "node_id" in a), None)
+    if kill is not None:
+        victims = {m for m, p in primaries.items() if p == kill["node_id"]}
+        recovered = [e[0] + e[1] for e in log
+                     if e[3] in victims and e[2] is None
+                     and e[0] + e[1] > kill["fired_at"]]
+        if recovered:
+            failover_s = min(recovered) - kill["fired_at"]
+            metric_catalog.CHAOS_FAILOVER_SECONDS.set(failover_s)
+
+    error_counts: Dict[str, int] = {}
+    for e in log:
+        if e[2] is not None:
+            error_counts[e[2]] = error_counts.get(e[2], 0) + 1
+    report.update({
+        "scheduled": total,
+        "succeeded": ok_n,
+        "availability": round(availability, 5),
+        "failover_s": round(failover_s, 3) if failover_s is not None else None,
+        "p99_ms": round((merged.quantile(0.99) or 0.0) * 1000.0, 2)
+        if merged.count else None,
+        "errors": dict(sorted(error_counts.items())),
+        "actions": fired,
+        "chaff": chaff_results,
+        "drift": drift_result or None,
+        "invariants": results,
+        "ok": all(r["ok"] for r in results),
+    })
+    return report

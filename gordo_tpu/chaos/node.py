@@ -1,0 +1,209 @@
+"""
+The chaos fleet's serving node: ``python -m gordo_tpu.chaos.node``.
+
+A real lease-holding member of the gateway's fleet with the real serving
+resilience pieces — membership registration + heartbeat
+(server/membership.py), per-model circuit breakers
+(server/resilience.py), the serving fault sites (util/faults.py) — but
+no model stack, so it imports in under a second and a SIGKILL/SIGSTOP
+from the conductor is a literal OS signal against a literal lease
+heartbeat, not an in-process stand-in.
+
+Routes:
+
+- ``GET /healthcheck`` — liveness;
+- ``GET /debug/slo`` — the shape the gateway's drain poller reads:
+  worst-model ``latency_burn_rate`` computed from a sliding window of
+  this node's own request latencies against
+  ``GORDO_TPU_CHAOS_NODE_SLO_MS`` (so a wedged device call genuinely
+  drives the burn up and the drain genuinely fires);
+- ``GET /chaos/breakers`` — {model: breaker state} for the
+  ``breaker_scoped`` invariant checker;
+- ``/gordo/v0/<project>/<machine>/...`` — the serving path: first hit
+  per machine passes ``serve_model_load`` (wedge = artifact-load stall),
+  every hit passes ``serve_predict`` then ``serve_device_call`` (wedge =
+  stuck device call), all guarded by the machine's circuit breaker.
+  Injected transients answer 503 + Retry-After, permanents 500 — the
+  same status contract as the real views.
+
+Stdout protocol: one ``CHAOS-NODE READY <node_id> <port>`` line once the
+lease is registered and the socket is listening; the stack spawner
+blocks on it.
+"""
+
+import argparse
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gordo_tpu.server import membership, resilience
+from gordo_tpu.util import faults
+
+_BURN_WINDOW = 200
+
+
+def _slo_s() -> float:
+    try:
+        return max(0.001, float(os.environ.get("GORDO_TPU_CHAOS_NODE_SLO_MS", 250)) / 1000.0)
+    except ValueError:
+        return 0.25
+
+
+def _work_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get("GORDO_TPU_CHAOS_NODE_WORK_MS", 2)) / 1000.0)
+    except ValueError:
+        return 0.002
+
+
+class ChaosNode:
+    def __init__(self, directory: str, node_id: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.node_id = node_id
+        self.hits = 0
+        self._latencies = collections.deque(maxlen=_BURN_WINDOW)
+        self._loaded = set()
+        self._lock = threading.Lock()
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                node.handle(self)
+
+            do_POST = do_GET
+
+            def log_message(self, *args):  # noqa: D102 — keep stdout clean
+                pass
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.registration = membership.NodeRegistration(
+            directory, address=f"{host}:{self.port}", node_id=node_id,
+        )
+
+    # ------------------------------------------------------------ serving
+    def handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        if path == "/healthcheck":
+            return self._json(req, 200, {"node": self.node_id, "ok": True})
+        if path == "/debug/slo":
+            return self._json(req, 200, self._slo_doc())
+        if path == "/chaos/breakers":
+            return self._json(req, 200, {"node": self.node_id,
+                                         "breakers": self._breaker_states()})
+        parts = path.split("/")
+        if len(parts) >= 5 and parts[1] == "gordo" and parts[2] == "v0":
+            return self._serve(req, machine=parts[4])
+        return self._json(req, 404, {"error": f"no route {path}"})
+
+    def _serve(self, req: BaseHTTPRequestHandler, machine: str) -> None:
+        start = time.monotonic()
+        self.hits += 1
+        breaker = resilience.breaker_for(machine)
+        if breaker is not None:
+            info = breaker.allow()
+            if info is not None:
+                header = ("Retry-After",
+                          resilience.breaker_retry_after_header(info))
+                return self._json(req, 503, info, extra=[header])
+        try:
+            with self._lock:
+                cold = machine not in self._loaded
+            if cold:
+                # first touch = artifact load; a wedge rule here is the
+                # slow-store stall, a permanent is a corrupt artifact
+                faults.fault_point("serve_model_load", machine=machine)
+                with self._lock:
+                    self._loaded.add(machine)
+            faults.fault_point("serve_predict", machine=machine)
+            faults.fault_point("serve_device_call", machine=machine)
+            time.sleep(_work_s())
+        except Exception as exc:  # noqa: BLE001 — injected faults only
+            resilience.record_breaker_failure(breaker, exc)
+            transient = faults.is_transient(exc)
+            status = 503 if transient else 500
+            extra = [("Retry-After", "1")] if transient else []
+            self._latencies.append(time.monotonic() - start)
+            return self._json(
+                req, status,
+                {"error": str(exc), "node": self.node_id, "machine": machine},
+                extra=extra,
+            )
+        resilience.record_breaker_success(breaker)
+        self._latencies.append(time.monotonic() - start)
+        return self._json(
+            req, 200, {"node": self.node_id, "machine": machine},
+        )
+
+    # ---------------------------------------------------------- telemetry
+    def _slo_doc(self) -> dict:
+        lat = list(self._latencies)
+        slo = _slo_s()
+        slow = sum(1 for v in lat if v > slo) / len(lat) if lat else 0.0
+        # burn = slow fraction over the 5% error budget, the same
+        # worst-model shape server/debug.py reports
+        burn = slow / 0.05
+        return {
+            "local": {
+                "models": {
+                    "_chaos": {"5m": {"latency_burn_rate": burn,
+                                      "requests": len(lat)}},
+                }
+            },
+            "node": self.node_id,
+        }
+
+    def _breaker_states(self) -> dict:
+        with resilience._breakers_lock:
+            breakers = dict(resilience._breakers)
+        return {model: b.state for model, b in breakers.items()}
+
+    def _json(self, req, status: int, doc: dict, extra=()) -> None:
+        body = json.dumps(doc).encode()
+        req.send_response(status)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        for name, value in extra:
+            req.send_header(name, value)
+        req.end_headers()
+        try:
+            req.wfile.write(body)
+        except OSError:
+            pass
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def close(self) -> None:
+        self.registration.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", required=True, help="membership directory")
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+    node = ChaosNode(args.dir, args.node_id, args.host, args.port)
+    print(f"CHAOS-NODE READY {node.node_id} {node.port}", flush=True)
+    try:
+        node.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -760,11 +760,97 @@ def drift_rebuilder(
         _time.sleep(poll_interval)
 
 
+@click.group("chaos")
+def chaos_cli():
+    """Chaos conductor: failure drills against a real gateway + fleet.
+
+    Scenario files (resources/chaos/*.yaml) declare the stack, the
+    shaped load, the fault timeline and the invariants; ``run`` spins
+    the whole thing up, fires it, and exits nonzero if any invariant
+    fails. See docs/robustness.md ("Chaos conductor").
+    """
+
+
+@chaos_cli.command("run")
+@click.argument("scenario", type=click.Path(exists=True))
+@click.option(
+    "--dir",
+    "work_dir",
+    type=click.Path(),
+    default=None,
+    help="Working directory for the drill (membership leases, drift "
+    "queue). Default: a fresh temporary directory, removed afterwards.",
+)
+@click.option(
+    "--out",
+    type=click.Path(),
+    default=None,
+    help="Also write the full JSON report to this path",
+)
+@click.option("--verbose", is_flag=True, default=False,
+              help="Stack and gateway logs to stderr")
+def chaos_run(scenario: str, work_dir: str, out: str, verbose: bool):
+    """Run one chaos scenario; exit 0 iff every invariant holds."""
+    import shutil
+    import tempfile
+
+    from gordo_tpu.chaos import load_scenario, run_scenario
+
+    if verbose:
+        logging.basicConfig(level=logging.INFO)
+    spec = load_scenario(scenario)
+    directory = work_dir or tempfile.mkdtemp(prefix="gordo-chaos-")
+    try:
+        report = run_scenario(spec, directory)
+    finally:
+        if work_dir is None:
+            shutil.rmtree(directory, ignore_errors=True)
+    if out:
+        with open(out, "w") as fh:
+            json.dump(report, fh, indent=1)
+    for res in report["invariants"]:
+        mark = "PASS" if res["ok"] else "FAIL"
+        click.echo(f"[{mark}] {res['check']}: {res['detail']}")
+    click.echo(
+        f"{report['scenario']}: availability={report['availability']} "
+        f"p99={report['p99_ms']}ms failover_s={report['failover_s']} "
+        f"-> {'OK' if report['ok'] else 'FAILED'}"
+    )
+    sys.exit(0 if report["ok"] else 1)
+
+
+@chaos_cli.command("list")
+@click.option(
+    "--dir",
+    "scenario_dir",
+    type=click.Path(exists=True),
+    default="resources/chaos",
+    help="Directory of scenario files",
+)
+def chaos_list(scenario_dir: str):
+    """List the committed scenarios and their declared invariants."""
+    from gordo_tpu.chaos import load_scenario
+
+    for name in sorted(os.listdir(scenario_dir)):
+        if not name.endswith((".yaml", ".yml", ".json")):
+            continue
+        path = os.path.join(scenario_dir, name)
+        try:
+            spec = load_scenario(path)
+        except Exception as exc:  # noqa: BLE001 — a broken file is listed as such
+            click.echo(f"{name}: INVALID ({exc})")
+            continue
+        checks = ",".join(inv.check for inv in spec.invariants)
+        click.echo(f"{name}: {spec.name} — nodes={spec.nodes} "
+                   f"phases={len(spec.phases)} invariants=[{checks}]")
+
+
 gordo.add_command(build)
 gordo.add_command(batch_build)
 gordo.add_command(run_server_cli)
 gordo.add_command(run_gateway_cli)
 gordo.add_command(drift_rebuilder)
+gordo.add_command(chaos_cli)
 
 
 def _append_workflow_commands():

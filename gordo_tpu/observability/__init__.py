@@ -3,6 +3,8 @@ from gordo_tpu.observability import telemetry  # noqa: F401
 from gordo_tpu.observability import tracing  # noqa: F401
 from gordo_tpu.observability.grafana import (  # noqa: F401
     build_dashboard,
+    chaos_dashboard,
+    drift_dashboard,
     fleet_dashboard,
     gateway_dashboard,
     machines_dashboard,

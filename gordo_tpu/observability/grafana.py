@@ -1154,6 +1154,85 @@ def drift_dashboard() -> Dict[str, Any]:
     return _dashboard("Gordo TPU drift loop", "gordo-tpu-drift", panels)
 
 
+def chaos_dashboard() -> Dict[str, Any]:
+    """Availability-under-abuse dashboard (ISSUE 16) over the chaos
+    conductor's drill metrics (chaos/conductor.py). A drill publishes
+    its availability, failover bound, fired fault actions and invariant
+    verdicts into the telemetry registry, so a scrape during `gordo
+    chaos run` (or the bench `abuse` section) lands here."""
+    panels = [
+        _timeseries(
+            "Fault actions fired",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_chaos_actions_total"
+                    "[5m])) by (action)",
+                    "legend": "{{action}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            description=(
+                "Timeline actions the conductor executed against the "
+                "drill stack (kill_node, stop_node, lease corruption, "
+                "gateway connection drops, fault-plan swaps)"
+            ),
+        ),
+        _timeseries(
+            "Invariant failures",
+            [
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_chaos_invariant_failures_total[5m])) "
+                    "by (invariant)",
+                    "legend": "FAILED {{invariant}}",
+                }
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            description=(
+                "Machine-checked invariants (availability floor, "
+                "zero-5xx, failover bound, breaker scoping, exact "
+                "histogram merge) that did NOT hold — any point on this "
+                "panel is a failed drill"
+            ),
+        ),
+        _stat(
+            "Drill availability",
+            "max(gordo_server_chaos_availability_ratio)",
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+            unit="percentunit",
+        ),
+        _stat(
+            "Failover (kill to recovery)",
+            "max(gordo_server_chaos_failover_seconds)",
+            panel_id=4,
+            x=6,
+            y=_PANEL_H,
+            unit="s",
+        ),
+        _stat(
+            "Actions fired (total)",
+            "sum(gordo_server_chaos_actions_total)",
+            panel_id=5,
+            x=_PANEL_W,
+            y=_PANEL_H,
+        ),
+        _stat(
+            "Invariant failures (total)",
+            "sum(gordo_server_chaos_invariant_failures_total)",
+            panel_id=6,
+            x=_PANEL_W + 6,
+            y=_PANEL_H,
+        ),
+    ]
+    return _dashboard("Gordo TPU chaos drills", "gordo-tpu-chaos", panels)
+
+
 def write_dashboards(out_dir: str) -> List[str]:
     """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -1166,6 +1245,7 @@ def write_dashboards(out_dir: str) -> List[str]:
         ("gordo_tpu_fleet.json", fleet_dashboard),
         ("gordo_tpu_gateway.json", gateway_dashboard),
         ("gordo_tpu_drift.json", drift_dashboard),
+        ("gordo_tpu_chaos.json", chaos_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

@@ -476,3 +476,27 @@ HOT_SWAP_FAILURES = telemetry.counter(
     "artifact keeps serving; the watcher retries next poll)",
     ("model",),
 )
+
+# --------------------------------------------------- chaos conductor
+CHAOS_ACTIONS = telemetry.counter(
+    "gordo_server_chaos_actions_total",
+    "Fault actions fired by the chaos conductor (gordo chaos run): node "
+    "kills/stops, lease tampering, connection drops, fault-plan re-arms",
+    ("action",),
+)
+CHAOS_INVARIANT_FAILURES = telemetry.counter(
+    "gordo_server_chaos_invariant_failures_total",
+    "Chaos-scenario invariants that failed their machine check "
+    "(availability floor, failover bound, breaker scoping, exact merge)",
+    ("invariant",),
+)
+CHAOS_AVAILABILITY = telemetry.gauge(
+    "gordo_server_chaos_availability_ratio",
+    "Measured non-chaff availability of the last chaos drill: successful "
+    "requests over scheduled requests, from the exactly-merged log",
+)
+CHAOS_FAILOVER_SECONDS = telemetry.gauge(
+    "gordo_server_chaos_failover_seconds",
+    "Seconds from the drill's node kill to the first successful answer "
+    "for a machine whose ring primary was the killed node",
+)

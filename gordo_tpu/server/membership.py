@@ -247,10 +247,22 @@ class NodeRegistration:
                 )
                 return
             try:
+                # chaos hook: a ``lease_refresh`` rule skips THIS refresh
+                # only — the node keeps serving and heartbeating while its
+                # lease ages toward stale (the expired-but-alive split the
+                # gateway must route around), unlike node_dead above which
+                # ends the heartbeat for good. A ``wedge`` rule stalls the
+                # beat instead (slow shared filesystem stand-in).
+                faults.fault_point("lease_refresh", machine=self.node_id)
                 self._refresh()
             except OSError:
                 logger.exception(
                     "node %s heartbeat refresh failed", self.node_id
+                )
+            except Exception as exc:  # noqa: BLE001 — injected: skip one beat
+                logger.warning(
+                    "node %s: injected lease_refresh skip (%s)",
+                    self.node_id, exc,
                 )
 
     def close(self) -> None:

@@ -286,6 +286,11 @@ class CircuitBreaker:
         self._consecutive = 0
         self._opened_at = 0.0
         self._probing = False
+        # when the in-flight probe was admitted: a probe whose thread dies
+        # without ever reporting (killed worker, lost connection) must not
+        # wedge the breaker half-open forever — after a further cooldown
+        # the probe lease expires and allow() admits a replacement
+        self._probe_started_at = 0.0
 
     # ------------------------------------------------------------- public
     @property
@@ -302,9 +307,21 @@ class CircuitBreaker:
             if self._state == OPEN and now - self._opened_at >= self.cooldown_s:
                 self._set_state(HALF_OPEN)
                 self._probing = True
+                self._probe_started_at = now
                 return None  # this caller is the probe
             if self._state == HALF_OPEN and not self._probing:
                 self._probing = True
+                self._probe_started_at = now
+                return None
+            if (
+                self._state == HALF_OPEN
+                and self._probing
+                and now - self._probe_started_at >= self.cooldown_s
+            ):
+                # probe lease expired: the admitted probe never reported
+                # back (its thread died mid-call) — admit one replacement
+                # per elapsed cooldown instead of fast-failing forever
+                self._probe_started_at = now
                 return None
             remaining = max(0.0, self.cooldown_s - (now - self._opened_at))
             metric_catalog.BREAKER_FAST_FAILURES.labels(model=self.model).inc()

@@ -38,6 +38,8 @@ from typing import Iterable, Optional
 
 import numpy as np
 
+from gordo_tpu.util import faults
+
 logger = logging.getLogger(__name__)
 
 # the most recent warmup_collection report (any trigger: boot, hot-swap
@@ -95,6 +97,22 @@ def _load_shipped_programs(model, artifact_dir) -> int:
         return 0
     manifest = programs_mod.load_manifest(artifact_dir)
     if manifest is None:
+        return 0
+    try:
+        # chaos hook (ISSUE 16): an ``aot_program_load`` rule rejects this
+        # artifact's shipped programs (serving proceeds on the ordinary
+        # compile path, counted like a real fingerprint rejection); a
+        # ``wedge`` rule stalls here — the slow-disk artifact-load stand-in
+        faults.fault_point(
+            "aot_program_load", machine=os.path.basename(artifact_dir)
+        )
+    except Exception as exc:  # noqa: BLE001 — injected: reject, don't crash
+        entries = manifest.get("programs") or []
+        batcher.note_rejected_shipment(len(entries))
+        logger.warning(
+            "rejecting %d shipped AOT program(s) from %s: injected "
+            "aot_program_load fault (%s)", len(entries), artifact_dir, exc,
+        )
         return 0
     status, reason = programs_mod.classify_manifest(manifest)
     if status == "rejected":

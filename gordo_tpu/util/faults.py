@@ -95,6 +95,22 @@ is never created, exercising the next detection window's retry;
 ``swap_commit`` fires at the start of a hot-swap cutover (machine = the
 model being swapped) — an injected error leaves the OLD revision serving
 untouched and the next watcher poll retries the swap.
+
+Chaos-conductor sites (ISSUE 16, gordo_tpu/chaos/ + server/warmup.py +
+server/membership.py): ``aot_program_load`` fires before a shipped AOT
+serving-program manifest is loaded (machine = the model name) — an
+injected permanent rejects the artifact's programs (serving falls back
+to the ordinary compile path, counted loudly), a ``wedge`` is the
+slow-disk stand-in that stalls the artifact load; ``lease_refresh``
+fires inside a serving node's heartbeat just before the lease-file
+refresh (machine = node id) — an injected error SKIPS that refresh
+(the node keeps serving while its lease goes stale: the
+expired-but-alive split the gateway must route around), unlike
+``node_dead`` which kills the whole heartbeat. The conductor
+(``gordo chaos run``) scripts these sites from declarative scenario
+files; ``KNOWN_SITES`` below is the vocabulary
+``scripts/lint_chaos_scenario.py`` validates scenario fault rules
+against.
 """
 
 import json
@@ -114,6 +130,25 @@ PLAN_ENV = "GORDO_TPU_FAULT_PLAN"
 EXIT_ALL_BUILT = 0
 EXIT_PARTIAL = 81
 EXIT_NONE_BUILT = 82
+
+# every fault-plan site wired somewhere under gordo_tpu/ — the single
+# source of truth for scenario linting (scripts/lint_chaos_scenario.py)
+# and the chaos conductor's plan validation. Append-only: a site name in
+# a committed scenario file is a public contract.
+KNOWN_SITES = (
+    # build plane
+    "data_fetch", "poison_nan", "diverge", "bucket_compile",
+    "scheduler_lease",
+    # serve plane
+    "serve_model_load", "serve_predict", "serve_device_call",
+    "serve_poison_nan",
+    # gateway / membership plane
+    "gateway_route", "node_partition", "node_dead", "lease_refresh",
+    # drift loop
+    "drift_detect", "drift_enqueue", "swap_commit",
+    # build-to-serve artifacts
+    "aot_program_load",
+)
 
 # quarantine stages (where in the build the machine was dropped)
 STAGE_DATA_FETCH = "data_fetch"

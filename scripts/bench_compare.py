@@ -101,6 +101,14 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # pair carry them.
     ("cold_start_time_to_first_fused_s", False),
     ("cold_start_serve_time_compiles", False),
+    # availability under abuse (ISSUE 16): the chaos drill's availability
+    # gates higher-is-better; the flash-crowd p99, kill-to-first-hedged-
+    # success failover time and error burn gate lower-is-better. Absent
+    # in pre-v6 records, so they only gate once both sides carry them.
+    ("abuse_availability", True),
+    ("abuse_flash_p99_ms", False),
+    ("abuse_failover_s", False),
+    ("abuse_error_burn", False),
 )
 
 # which harness section feeds each metric (schema v2 records carry a
@@ -124,6 +132,8 @@ def metric_section(key: str, parsed: dict) -> Optional[str]:
         return "drift_loop"
     if key.startswith("cold_start_"):
         return "cold_start"
+    if key.startswith("abuse_"):
+        return "abuse"
     return None
 
 

@@ -73,6 +73,10 @@ _NUMERIC_KEYS = (
     # first fused predict with shipped AOT programs, and the serve-side
     # trace-compile count in that arm (the ~0 tentpole claim)
     "cold_start_time_to_first_fused_s", "cold_start_serve_time_compiles",
+    # the availability-under-abuse chaos section (ISSUE 16): drill
+    # availability, flash-crowd p99, kill-to-recovery seconds, error burn
+    "abuse_availability", "abuse_flash_p99_ms", "abuse_failover_s",
+    "abuse_error_burn",
 )
 
 
@@ -86,6 +90,8 @@ _FALLBACK_NAMES_BY_VERSION = {
         "fleet_build", "drift_loop"],
     5: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build", "drift_loop", "cold_start"],
+    6: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build", "drift_loop", "cold_start", "abuse"],
 }
 _FALLBACK_STATUSES = [
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""
+Lint: every chaos scenario file under ``resources/chaos/`` must parse
+against the conductor's actual vocabulary.
+
+A scenario is executable configuration: a typo'd action name, an
+out-of-range node index, a schedule shape the load generator doesn't
+know, or a fault site no code path visits would otherwise surface only
+when someone RUNS the drill — which for the rarely-run scenarios is
+exactly when a real incident is being reproduced. The vocabulary is
+imported from the code that executes it (single source of truth):
+
+- schema + action/invariant names: gordo_tpu/chaos/scenario.py
+  (``ACTIONS``, ``INVARIANTS``, the parser itself);
+- fault sites: gordo_tpu/util/faults.py ``KNOWN_SITES``;
+- schedule shapes: benchmarks/load_test.py ``SCHEDULE_SHAPES``.
+
+Beyond parsing, each file must declare at least one invariant (a drill
+that asserts nothing is load, not a drill) and a bounded horizon
+(total load under ``--max-horizon`` seconds, default 120 — scenarios
+are CI-runnable by contract).
+
+Usage: ``python scripts/lint_chaos_scenario.py [paths-or-dirs ...]``
+(default: ``resources/chaos``). Exit 0 = clean, 1 = violations (one per
+line), 2 = bad invocation. Wired into tier-1 via
+tests/gordo_tpu/test_lint.py.
+"""
+
+import argparse
+import pathlib
+import sys
+from typing import List
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def lint_file(path: pathlib.Path, max_horizon: float) -> List[str]:
+    from gordo_tpu.chaos.scenario import ScenarioError, load_scenario
+
+    try:
+        spec = load_scenario(str(path))
+    except ScenarioError as exc:
+        return [f"{path}: {exc}"]
+    except Exception as exc:  # noqa: BLE001 — unparseable counts as a violation
+        return [f"{path}: unreadable ({exc!r})"]
+
+    problems = []
+    if not spec.invariants:
+        problems.append(f"{path}: declares no invariants (asserts nothing)")
+    horizon = sum(p.warmup + p.duration for p in spec.phases)
+    if horizon > max_horizon:
+        problems.append(
+            f"{path}: load horizon {horizon:.0f}s exceeds {max_horizon:.0f}s "
+            f"(scenarios must stay CI-runnable)"
+        )
+    for action in spec.timeline:
+        if action.at > horizon:
+            problems.append(
+                f"{path}: timeline action {action.action!r} at {action.at}s "
+                f"fires after the load ends ({horizon:.0f}s)"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="scenario files or directories")
+    parser.add_argument("--max-horizon", type=float, default=120.0)
+    args = parser.parse_args(argv)
+
+    roots = [pathlib.Path(p) for p in (args.paths or ["resources/chaos"])]
+    files: List[pathlib.Path] = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(
+                p for p in root.iterdir()
+                if p.suffix.lower() in (".yaml", ".yml", ".json")
+            ))
+        elif root.is_file():
+            files.append(root)
+        else:
+            print(f"no such file or directory: {root}", file=sys.stderr)
+            return 2
+    if not files:
+        print("no scenario files found", file=sys.stderr)
+        return 2
+
+    problems: List[str] = []
+    for path in files:
+        problems.extend(lint_file(path, args.max_horizon))
+    for problem in problems:
+        print(problem)
+    if not problems:
+        print(f"chaos-scenario lint: {len(files)} file(s) clean")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

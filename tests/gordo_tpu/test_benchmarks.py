@@ -777,3 +777,183 @@ def test_bench_compare_smoke_on_checked_in_records():
     assert records, "no BENCH_r*.json records checked in"
     result = _run_compare(records[0], records[-1])
     assert result.returncode in (0, 1, 2), result.stderr
+
+
+# ------------------------------------------- shaped open-loop schedules
+def test_build_schedule_flat_is_the_plain_open_loop_grid():
+    """Default-off pin: the flat shape IS run_open's implicit ``i/qps``
+    arrival grid, element for element — shapes are a superset of the
+    plain open loop, never a drift from it."""
+    qps, duration = 37.0, 2.0
+    schedule = load_test.build_schedule("flat", qps, duration)
+    assert schedule == [i / qps for i in range(int(round(duration * qps)))]
+
+
+def test_build_schedule_diurnal_exact_inversion():
+    """Diurnal arrivals invert the closed-form cumulative-rate curve: the
+    i-th arrival t_i satisfies N(t_i) == i to float precision, offsets
+    are strictly increasing, and amp=0 degenerates to the flat grid."""
+    import math
+
+    qps, duration, amp = 20.0, 4.0, 0.5
+    schedule = load_test.build_schedule("diurnal", qps, duration, amp=amp)
+    assert schedule == sorted(schedule)
+    assert len(schedule) == int(round(qps * duration))
+    two_pi = 2.0 * math.pi
+
+    def cum(t):
+        return qps * (
+            t - amp * duration / two_pi
+            * (math.cos(two_pi * t / duration) - 1.0)
+        )
+
+    for i, t in enumerate(schedule):
+        assert abs(cum(t) - i) < 1e-6, (i, t)
+
+    flat_again = load_test.build_schedule("diurnal", qps, duration, amp=0.0)
+    grid = load_test.build_schedule("flat", qps, duration)
+    assert all(abs(a - b) < 1e-6 for a, b in zip(flat_again, grid))
+
+
+def test_build_schedule_flash_burst_placement():
+    """Flash = the flat base plus an extra (peak-1)x burst of evenly
+    spaced arrivals confined to [flash_at, flash_at + flash_len)."""
+    qps, duration = 10.0, 4.0
+    schedule = load_test.build_schedule(
+        "flash", qps, duration, peak=4.0, flash_at=1.0, flash_len=1.0
+    )
+    base = load_test.build_schedule("flat", qps, duration)
+    extra = sorted(schedule)
+    for t in base:
+        extra.remove(t)
+    assert len(extra) == int(round(1.0 * qps * 3.0))  # (peak-1) * len * qps
+    assert all(1.0 <= t < 2.0 for t in extra), extra
+    assert schedule == sorted(schedule)
+
+    with pytest.raises(ValueError):
+        load_test.build_schedule("sawtooth", qps, duration)
+
+
+def test_skewed_key_picker_deterministic_hot_key():
+    keys = [f"m-{i:03d}" for i in range(10)]
+    pick = load_test.skewed_key_picker(keys, hot_pct=40.0, seed=3)
+    again = load_test.skewed_key_picker(keys, hot_pct=40.0, seed=3)
+    chosen = [pick(i) for i in range(1000)]
+    assert chosen == [again(i) for i in range(1000)]  # pure determinism
+    hot = keys[3 % len(keys)]
+    hot_share = chosen.count(hot) / len(chosen)
+    assert hot_share > 0.30  # ~40% + its round-robin turns
+    # no skew -> plain round-robin
+    rr = load_test.skewed_key_picker(keys, hot_pct=0.0)
+    assert [rr(i) for i in range(20)] == [keys[i % 10] for i in range(20)]
+
+
+def test_run_open_sharded_lease_split_and_exact_merge(tmp_path):
+    """Filesystem-lease sharding: independent workers claim disjoint
+    shards of ONE global schedule via O_EXCL lease files, and the merged
+    result accounts for every arrival exactly once — histogram counts
+    add, no double-sends, no gaps."""
+    from gordo_tpu.observability.latency import LatencyHistogram
+
+    schedule = load_test.build_schedule("flat", 200.0, 0.5)
+    shard_dir = str(tmp_path / "shards")
+    os.makedirs(shard_dir)
+    sent = []
+    sent_lock = threading.Lock()
+
+    def send(key):
+        with sent_lock:
+            sent.append(key)
+        return None, None, {}
+
+    keys = [f"m-{i:03d}" for i in range(5)]
+    key_of = load_test.skewed_key_picker(keys, hot_pct=20.0, seed=1)
+    claimed = []
+    workers = [
+        threading.Thread(
+            target=lambda who: claimed.extend(
+                load_test.run_open_sharded(
+                    send, 2, schedule, 4, shard_dir,
+                    owner=who, keep_log=True, key_of=key_of,
+                )
+            ),
+            args=(f"owner-{w}",),
+        )
+        for w in range(2)
+    ]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+
+    assert sorted(claimed) == [0, 1, 2, 3]  # every shard claimed exactly once
+    assert len(sent) == len(schedule)       # no double-sends, no gaps
+    stats_list, wall, missing = load_test.merge_shard_results(
+        shard_dir, 4, timeout=10.0
+    )
+    assert missing == []
+    merged = LatencyHistogram.merged(s.hist for s in stats_list)
+    assert merged.count == len(schedule)
+    # and the logged per-arrival keys match the deterministic picker
+    logged_keys = sorted(
+        entry[3] for s in stats_list for entry in s.log
+    )
+    assert logged_keys == sorted(key_of(i) for i in range(len(schedule)))
+
+
+def test_chaff_and_pipelined_burst_against_threaded_server():
+    """slow-loris chaff gives up at its deadline (server surviving), a
+    scanner gets answered without killing the listener, and the
+    pipelining probe gets every response in order on one connection."""
+    import http.server
+    import socketserver
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            body = b'{"ok": true}'
+            self.send_response(200 if self.path == "/ping" else 404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    httpd = socketserver.ThreadingTCPServer(
+        ("127.0.0.1", 0), Handler, bind_and_activate=True
+    )
+    httpd.daemon_threads = True
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        loris = load_test.run_chaff(
+            "127.0.0.1", port, "slow_loris", conns=2, duration=0.6
+        )
+        assert loris["opened"] == 2
+        scan = load_test.run_chaff(
+            "127.0.0.1", port, "scanner", conns=2, duration=0.5
+        )
+        assert scan["opened"] >= 2
+        assert scan["responses"] >= 2  # 404s, but answered — server alive
+
+        burst = load_test.pipelined_burst(
+            "127.0.0.1", port, "/ping", burst=4, rounds=2
+        )
+        assert burst["responses"] == 8
+        assert burst["ok"] == 8
+        assert "error" not in burst
+
+        # the server survived the abuse: a normal request still works
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/ping")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -1,0 +1,232 @@
+"""
+Chaos conductor tests (ISSUE 16): scenario schema validation, the
+machine-checked invariant vocabulary on synthetic run contexts, and one
+tiny end-to-end drill (2 nodes, kill one mid-load) — the committed
+scenarios under resources/chaos/ are the full-size drills; this keeps
+the conductor's contract pinned at tier-1 speed.
+"""
+
+import json
+import os
+import tempfile
+
+import pytest
+
+from gordo_tpu.chaos import invariants as inv
+from gordo_tpu.chaos import scenario as scn
+from gordo_tpu.chaos.conductor import run_scenario
+from gordo_tpu.observability.latency import LatencyHistogram
+from gordo_tpu.server import resilience
+
+
+# ------------------------------------------------------ scenario schema
+def _minimal_doc(**overrides):
+    doc = {
+        "name": "unit",
+        "stack": {"nodes": 2},
+        "machines": 4,
+        "load": {"phases": [{"shape": "flat", "qps": 10, "duration": 1}]},
+        "invariants": [{"check": "availability", "min": 0.9}],
+    }
+    doc.update(overrides)
+    return doc
+
+
+def test_parse_scenario_minimal():
+    spec = scn.parse_scenario(_minimal_doc())
+    assert spec.name == "unit"
+    assert spec.nodes == 2
+    assert spec.machines == ["m-000", "m-001", "m-002", "m-003"]
+    assert len(spec.phases) == 1 and spec.phases[0].shape == "flat"
+    assert spec.invariants[0].check == "availability"
+    assert spec.invariants[0].params == {"min": 0.9}
+
+
+@pytest.mark.parametrize(
+    "mutation",
+    [
+        {"load": {"phases": [{"shape": "sawtooth", "qps": 10, "duration": 1}]}},
+        {"load": {"phases": [{"shape": "flat", "qps": 10, "duration": 1,
+                              "bogus_knob": 3}]}},
+        {"timeline": [{"at": 0.5, "action": "reboot_node", "node": 0}]},
+        {"timeline": [{"at": 0.5, "action": "kill_node", "node": 7}]},
+        {"timeline": [{"at": 2.0, "action": "kill_node", "node": 0},
+                      {"at": 1.0, "action": "stop_node", "node": 1}]},
+        {"invariants": [{"check": "always_fine"}]},
+        {"fault_plan": {"rules": [{"site": "not_a_site", "times": 1,
+                                   "error": "transient"}]}},
+        {"load": {"phases": [{"shape": "flat", "qps": 10, "duration": 1}],
+                  "chaff": [{"kind": "udp_flood", "conns": 2,
+                             "duration": 1}]}},
+    ],
+)
+def test_parse_scenario_rejects_bad_vocabulary(mutation):
+    with pytest.raises(scn.ScenarioError):
+        scn.parse_scenario(_minimal_doc(**mutation))
+
+
+def test_load_scenario_json_and_yaml(tmp_path):
+    doc = _minimal_doc()
+    jpath = tmp_path / "s.json"
+    jpath.write_text(json.dumps(doc))
+    assert scn.load_scenario(str(jpath)).name == "unit"
+    ypath = tmp_path / "s.yaml"
+    ypath.write_text(
+        "name: unit\nstack: {nodes: 2}\nmachines: 4\n"
+        "load:\n  phases:\n    - {shape: flat, qps: 10, duration: 1}\n"
+        "invariants:\n  - {check: availability, min: 0.9}\n"
+    )
+    assert scn.load_scenario(str(ypath)).nodes == 2
+
+
+def test_committed_scenarios_all_parse():
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    chaos_dir = os.path.join(repo, "resources", "chaos")
+    files = sorted(
+        f for f in os.listdir(chaos_dir)
+        if f.endswith((".yaml", ".yml", ".json"))
+    )
+    assert len(files) >= 4, "the issue commits 4-6 scenarios"
+    for name in files:
+        spec = scn.load_scenario(os.path.join(chaos_dir, name))
+        assert spec.invariants, f"{name} asserts nothing"
+
+
+# -------------------------------------------------- invariant checkers
+def _ctx(**overrides):
+    """A synthetic RunContext: 10 arrivals over 2 machines, all ok."""
+    log = [
+        (i * 0.1, 0.005, None, f"m-{i % 2:03d}", 0) for i in range(10)
+    ]
+    hist = LatencyHistogram()
+    for e in log:
+        hist.record(e[1])
+    ctx = inv.RunContext(
+        log=log, hist=hist, per_phase={0: hist}, scheduled={0: 10},
+        primaries={"m-000": "node-0", "m-001": "node-1"},
+        actions=[], breakers={}, drift=None,
+    )
+    for key, value in overrides.items():
+        setattr(ctx, key, value)
+    return ctx
+
+
+def _run(name, ctx, **params):
+    results = inv.evaluate([scn.Invariant(check=name, params=params)], ctx)
+    return results[0]
+
+
+def test_availability_floor_and_exclude():
+    assert _run("availability", _ctx(), min=1.0)["ok"]
+    ctx = _ctx()
+    ctx.log[0] = (0.0, 0.005, "http-503", "m-000", 0)
+    assert not _run("availability", ctx, min=0.95)["ok"]
+    # the failing machine excluded: back over the floor
+    assert _run("availability", ctx, min=0.95, exclude=["m-000"])["ok"]
+
+
+def test_zero_5xx_counts_server_and_transport_errors_only():
+    ctx = _ctx()
+    ctx.log[1] = (0.1, 0.005, "http-404", "m-001", 0)  # 4xx is fine
+    assert _run("zero_5xx", ctx)["ok"]
+    ctx.log[2] = (0.2, 0.005, "ConnectionResetError(54)", "m-000", 0)
+    result = _run("zero_5xx", ctx)
+    assert not result["ok"]
+    assert _run("zero_5xx", ctx, max=1)["ok"]
+
+
+def test_failover_under_bound():
+    ctx = _ctx(actions=[
+        {"action": "kill_node", "node": 0, "node_id": "node-0",
+         "fired_at": 0.35},
+    ])
+    # m-000 (primary node-0) answers at 0.4+0.005 -> 0.055s after the kill
+    result = _run("failover_under", ctx, seconds=0.5)
+    assert result["ok"], result["detail"]
+    assert not _run("failover_under", ctx, seconds=0.01)["ok"]
+    # no kill action at all: the invariant fails loudly, not vacuously
+    assert not _run("failover_under", _ctx(), seconds=5)["ok"]
+
+
+def test_p99_under_merged_and_per_phase():
+    assert _run("p99_under", _ctx(), ms=1000)["ok"]
+    assert not _run("p99_under", _ctx(), ms=0.001)["ok"]
+    assert _run("p99_under", _ctx(), ms=1000, phase=0)["ok"]
+
+
+def test_breaker_scoped_blast_radius():
+    tripped = {"node-0": {"m-003": resilience.OPEN,
+                          "m-001": resilience.CLOSED}}
+    assert _run("breaker_scoped", _ctx(breakers=tripped),
+                models=["m-003"])["ok"]
+    # a breaker outside the poisoned set leaked
+    assert not _run("breaker_scoped", _ctx(breakers=tripped),
+                    models=["m-007"])["ok"]
+    # poison declared but nothing tripped: the drill proved nothing
+    assert not _run("breaker_scoped", _ctx(breakers={}),
+                    models=["m-003"])["ok"]
+
+
+def test_histogram_exact_accounting():
+    assert _run("histogram_exact", _ctx())["ok"]
+    # a lost arrival (scheduled but never logged) breaks exactness
+    assert not _run("histogram_exact", _ctx(scheduled={0: 11}))["ok"]
+    # an error wrongly recorded into the latency histogram breaks it too
+    ctx = _ctx()
+    ctx.log[0] = (0.0, 0.005, "http-503", "m-000", 0)
+    assert not _run("histogram_exact", ctx)["ok"]
+
+
+def test_one_rebuild_per_machine_exactly_once():
+    drift = {"machines": 4, "threads": 8, "enqueued": 4, "depth": 4}
+    assert _run("one_rebuild_per_machine", _ctx(drift=drift))["ok"]
+    dup = dict(drift, enqueued=6, depth=6)
+    assert not _run("one_rebuild_per_machine", _ctx(drift=dup))["ok"]
+    assert not _run("one_rebuild_per_machine", _ctx())["ok"]
+
+
+def test_unknown_invariant_fails_loudly():
+    result = _run("definitely_not_a_check", _ctx())
+    assert not result["ok"]
+    assert "unknown" in result["detail"]
+
+
+# ------------------------------------------------- tiny end-to-end drill
+def test_conductor_tiny_drill_kill_one_node():
+    """The smallest real drill: 2 subprocess nodes + in-process gateway,
+    flat load, one node killed mid-window. Pins the whole conductor loop
+    — stack boot, timeline firing, per-arrival accounting, invariant
+    evaluation — in a few seconds of tier-1 time."""
+    spec = scn.parse_scenario({
+        "name": "tiny-drill",
+        "seed": 1,
+        "stack": {"nodes": 2, "lease_timeout_s": 1.5, "heartbeat_s": 0.15,
+                  "gateway_env": {"health_s": "0.2",
+                                  "connect_timeout_s": "0.5"}},
+        "machines": 8,
+        "load": {"phases": [{"shape": "flat", "qps": 25, "duration": 2.0,
+                             "users": 4}]},
+        "timeline": [{"at": 0.8, "action": "kill_node", "node": 1}],
+        "invariants": [
+            {"check": "availability", "min": 0.9},
+            {"check": "failover_under", "seconds": 2.0},
+            {"check": "histogram_exact"},
+        ],
+    })
+    directory = tempfile.mkdtemp(prefix="gordo-chaos-test-")
+    try:
+        report = run_scenario(spec, directory)
+    finally:
+        import shutil
+
+        shutil.rmtree(directory, ignore_errors=True)
+    assert report["ok"], report["invariants"]
+    assert report["scheduled"] == 50
+    assert report["availability"] >= 0.9
+    assert [a["action"] for a in report["actions"]] == ["kill_node"]
+    assert report["failover_s"] is not None and report["failover_s"] <= 2.0
+    checks = {r["check"]: r["ok"] for r in report["invariants"]}
+    assert checks == {"availability": True, "failover_under": True,
+                      "histogram_exact": True}

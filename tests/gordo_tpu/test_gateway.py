@@ -19,6 +19,7 @@ fencing, breaker state transitions, placement-key parsing, and the
 
 import http.client
 import json
+import os
 import socket
 import threading
 import time
@@ -561,3 +562,136 @@ def test_chaos_kill_one_node_healthy_shards_unharmed(fleet, monkeypatch):
         dict(gateway.metric_catalog.GATEWAY_FAILOVERS.snapshot()).values()
     )
     assert failover_after > failover_before
+
+
+# -------------------------------------------- lease expiry edge cases
+def _newest_lease_path(directory: str, node_id: str) -> str:
+    nodes_dir = os.path.join(directory, "nodes")
+    candidates = sorted(
+        name for name in os.listdir(nodes_dir)
+        if name.startswith(f"{node_id}.g")
+    )
+    assert candidates, f"no lease file for {node_id}"
+    return os.path.join(nodes_dir, candidates[-1])
+
+
+def _storm(server, machines, seconds):
+    """Round-robin requests over ``machines``; returns [(machine, status,
+    serving_node)] — transport errors recorded as status -1."""
+    results = []
+    deadline = time.monotonic() + seconds
+    i = 0
+    while time.monotonic() < deadline:
+        machine = machines[i % len(machines)]
+        i += 1
+        try:
+            status, headers, _ = _gateway_request(
+                server, "GET", f"/gordo/v0/proj/{machine}/metadata",
+                timeout=5,
+            )
+            node = headers.get("x-gordo-gateway-node", "")
+        except OSError:
+            status, node = -1, ""
+        results.append((machine, status, node))
+        time.sleep(0.02)
+    return results
+
+
+def test_gateway_corrupted_lease_self_heals_no_5xx(fleet):
+    """A lease file overwritten with garbage mid-routing: the owner's
+    heartbeat (mkstemp + os.replace) restores a valid payload within one
+    beat, and meanwhile NO request — healthy shards or the victim's —
+    sees a 5xx: the victim either keeps routing (poll skips the corrupt
+    file only until the next beat) or hedges to its ring successor."""
+    server = fleet.server
+    directory = fleet.nodes[0].registration.directory
+    victim = "node-b"
+    lease = _newest_lease_path(directory, victim)
+
+    with open(lease, "w") as fh:
+        fh.write("\x00garbage{not json")
+
+    machines = [f"m-{i:03d}" for i in range(12)]
+    results = _storm(server, machines, seconds=1.5)
+    assert results
+    assert all(r[1] == 200 for r in results), [r for r in results if r[1] != 200]
+
+    # the heartbeat healed the file: valid payload, correct address
+    deadline = time.monotonic() + 2.0
+    payload = None
+    while time.monotonic() < deadline:
+        try:
+            with open(_newest_lease_path(directory, victim)) as fh:
+                payload = json.load(fh)
+            break
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    assert payload is not None, "corrupted lease never healed"
+    assert payload["node_id"] == victim
+    # ... and the gateway still (or again) sees the full fleet
+    deadline = time.monotonic() + 2.0
+    while len(server.ring.nodes) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(server.ring.nodes) == 3
+
+
+def test_gateway_deleted_lease_self_heals_no_5xx(fleet):
+    """A lease file deleted outright (operator fat-finger, janitor bug):
+    same contract as corruption — the heartbeat's os.replace recreates
+    the file within one beat, zero 5xx throughout, ring back to full
+    strength within one refresh interval."""
+    server = fleet.server
+    directory = fleet.nodes[0].registration.directory
+    victim = "node-c"
+    os.unlink(_newest_lease_path(directory, victim))
+
+    machines = [f"m-{i:03d}" for i in range(12)]
+    results = _storm(server, machines, seconds=1.5)
+    assert results
+    assert all(r[1] == 200 for r in results), [r for r in results if r[1] != 200]
+
+    # heartbeat recreated the lease and the gateway converged on 3 nodes
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        nodes_dir = os.path.join(directory, "nodes")
+        back = any(
+            name.startswith(f"{victim}.g") for name in os.listdir(nodes_dir)
+        )
+        if back and len(server.ring.nodes) == 3:
+            break
+        time.sleep(0.05)
+    assert any(
+        name.startswith(f"{victim}.g")
+        for name in os.listdir(os.path.join(directory, "nodes"))
+    ), "deleted lease never recreated"
+    assert len(server.ring.nodes) == 3
+
+
+def test_gateway_stale_orphan_lease_never_attracts_traffic(fleet):
+    """A stale-mtime lease for a node that no longer exists (crashed
+    before withdrawing, beyond the lease timeout): the gateway must treat
+    it as dead — it never joins the ring, never serves a request, and
+    healthy shards see zero 5xx while it sits there."""
+    server = fleet.server
+    directory = fleet.nodes[0].registration.directory
+    nodes_dir = os.path.join(directory, "nodes")
+    ghost = os.path.join(nodes_dir, "node-ghost.g1")
+    with open(ghost, "w") as fh:
+        fh.write(json.dumps({
+            "node_id": "node-ghost",
+            # a port nothing listens on: routing here would be a 5xx
+            "address": "127.0.0.1:1",
+            "pid": 0,
+            "ts": time.time() - 86400.0,
+        }))
+    os.utime(ghost, (time.time() - 86400.0, time.time() - 86400.0))
+
+    # let several health polls pass, then storm
+    time.sleep(0.8)
+    machines = [f"m-{i:03d}" for i in range(12)]
+    results = _storm(server, machines, seconds=1.2)
+    assert results
+    assert all(r[1] == 200 for r in results), [r for r in results if r[1] != 200]
+    assert all(r[2] != "node-ghost" for r in results)
+    assert "node-ghost" not in server.ring.nodes
+    assert "node-ghost" not in server._live

@@ -39,6 +39,7 @@ METRIC_LINT = REPO_ROOT / "scripts" / "lint_metric_names.py"
 KNOB_LINT = REPO_ROOT / "scripts" / "lint_env_knobs.py"
 RECORD_LINT = REPO_ROOT / "scripts" / "lint_bench_record.py"
 MANIFEST_LINT = REPO_ROOT / "scripts" / "lint_artifact_manifest.py"
+SCENARIO_LINT = REPO_ROOT / "scripts" / "lint_chaos_scenario.py"
 
 
 def test_no_bare_except_in_gordo_tpu():
@@ -561,3 +562,75 @@ def test_fleet_scrape_smoke(tmp_path, monkeypatch):
             assert name.startswith("gordo_"), line
     finally:
         shared.reset_for_tests()
+
+
+# ---------------------------------------------------- chaos-scenario lint
+def _run_scenario_lint(*paths):
+    return subprocess.run(
+        [sys.executable, str(SCENARIO_LINT), *map(str, paths)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_chaos_scenario_lint_committed_scenarios_pass():
+    """The bare invocation (what tier-1 runs): every scenario under
+    resources/chaos/ parses against the conductor's live vocabulary."""
+    result = _run_scenario_lint()
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_chaos_scenario_lint_flags_bad_vocabulary(tmp_path):
+    bad = tmp_path / "bad_action.yaml"
+    bad.write_text(
+        "name: bad\n"
+        "load:\n  phases:\n    - {shape: flat, qps: 5, duration: 2}\n"
+        "timeline:\n  - {at: 1.0, action: reboot_node, node: 0}\n"
+        "invariants:\n  - {check: availability, min: 0.9}\n"
+    )
+    result = _run_scenario_lint(bad)
+    assert result.returncode == 1
+    assert "reboot_node" in result.stdout
+
+    bad_site = tmp_path / "bad_site.yaml"
+    bad_site.write_text(
+        "name: bad-site\n"
+        "fault_plan:\n  rules:\n    - {site: not_a_site, error: transient}\n"
+        "invariants:\n  - {check: availability}\n"
+    )
+    result = _run_scenario_lint(bad_site)
+    assert result.returncode == 1
+    assert "not_a_site" in result.stdout
+
+
+def test_chaos_scenario_lint_flags_structural_problems(tmp_path):
+    # no invariants = asserts nothing; late action = never fires
+    empty = tmp_path / "no_invariants.yaml"
+    empty.write_text(
+        "name: hollow\n"
+        "load:\n  phases:\n    - {shape: flat, qps: 5, duration: 2}\n"
+    )
+    late = tmp_path / "late_action.yaml"
+    late.write_text(
+        "name: late\n"
+        "load:\n  phases:\n    - {shape: flat, qps: 5, duration: 2}\n"
+        "timeline:\n  - {at: 99.0, action: kill_node, node: 0}\n"
+        "invariants:\n  - {check: availability}\n"
+    )
+    result = _run_scenario_lint(empty, late)
+    assert result.returncode == 1
+    assert "no invariants" in result.stdout
+    assert "fires after the load ends" in result.stdout
+
+
+def test_chaos_scenario_lint_caps_horizon(tmp_path):
+    slow = tmp_path / "marathon.yaml"
+    slow.write_text(
+        "name: marathon\n"
+        "load:\n  phases:\n    - {shape: flat, qps: 5, duration: 600}\n"
+        "invariants:\n  - {check: availability}\n"
+    )
+    result = _run_scenario_lint(slow)
+    assert result.returncode == 1
+    assert "exceeds" in result.stdout

@@ -5,6 +5,8 @@ import re
 
 from gordo_tpu.observability import (
     build_dashboard,
+    chaos_dashboard,
+    drift_dashboard,
     fleet_dashboard,
     gateway_dashboard,
     machines_dashboard,
@@ -95,7 +97,7 @@ def test_latency_panels_use_quantiles_not_averages():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 7
+    assert len(paths) == 8
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
@@ -117,6 +119,8 @@ def test_checked_in_dashboards_are_current():
         ("gordo_tpu_resilience.json", resilience_dashboard),
         ("gordo_tpu_fleet.json", fleet_dashboard),
         ("gordo_tpu_gateway.json", gateway_dashboard),
+        ("gordo_tpu_drift.json", drift_dashboard),
+        ("gordo_tpu_chaos.json", chaos_dashboard),
     ):
         with open(os.path.join(out_dir, name)) as fh:
             assert json.load(fh) == build(), f"{name} is stale — regenerate with " \

@@ -148,6 +148,64 @@ def test_breaker_half_open_probe_closes_or_reopens(monkeypatch):
     assert breaker.allow() is None
 
 
+def test_breaker_half_open_admits_exactly_one_probe_under_race(monkeypatch):
+    """N threads hit allow() at the same instant on a cooled-down breaker:
+    exactly one is admitted as the probe, every loser gets the fast-fail
+    dict (with retry-after) without touching the model."""
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "30")
+    breaker = resilience.breaker_for("m-race")
+    for round_no in range(3):  # repeat: the race must lose every time
+        breaker.record_failure(faults.PermanentFault("corrupt"))
+        assert breaker.state == resilience.OPEN
+        breaker._opened_at -= 31  # cooldown elapsed, about to half-open
+        n = 32
+        barrier = threading.Barrier(n)
+        results = [None] * n
+
+        def hit(i):
+            barrier.wait()
+            results[i] = breaker.allow()
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        probes = [r for r in results if r is None]
+        rejected = [r for r in results if r is not None]
+        assert len(probes) == 1, f"round {round_no}: {len(probes)} probes admitted"
+        assert len(rejected) == n - 1
+        assert all("retry-after-seconds" in r for r in rejected)
+        assert breaker.state == resilience.HALF_OPEN
+        # loop back: the probe reports failure, breaker re-opens
+
+
+def test_breaker_lost_probe_does_not_wedge_half_open(monkeypatch):
+    """A probe whose thread dies without record_success/record_failure must
+    not leave the breaker rejecting everyone forever: after a further
+    cooldown the probe lease expires and one replacement is admitted."""
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "30")
+    breaker = resilience.breaker_for("m-lost")
+    breaker.record_failure(faults.PermanentFault("corrupt"))
+    breaker._opened_at -= 31
+    assert breaker.allow() is None  # probe admitted ... and then lost
+    assert breaker.allow() is not None  # others still fast-fail
+    # less than a cooldown later: still just the one outstanding probe
+    breaker._probe_started_at -= 15
+    assert breaker.allow() is not None
+    # a full cooldown after the probe started: lease expired, one (and
+    # only one) replacement probe goes through
+    breaker._probe_started_at -= 16
+    assert breaker.allow() is None
+    assert breaker.allow() is not None
+    # the replacement reporting back settles the breaker normally
+    breaker.record_success()
+    assert breaker.state == resilience.CLOSED
+    assert breaker.allow() is None
+
+
 # ------------------------------------------------------------ output guard
 def test_output_guard_off_by_default():
     import numpy as np
