@@ -67,5 +67,12 @@ chaos-smoke:
 	JAX_PLATFORMS=cpu python -m gordo_tpu.cli.cli chaos run \
 		resources/chaos/kill_node_mid_ramp.yaml
 
+# burst-profile a live event-loop server through its own debug surface
+# and assert the capture contains the event-loop frames (see
+# docs/observability.md "Profiling a live server")
+profile-smoke:
+	JAX_PLATFORMS=cpu python scripts/profile_smoke.py
+
 .PHONY: image push test dryrun smoke render-gate bench bench-gate \
-	lint-bench-records lint-dashboards lint-chaos-scenarios chaos-smoke
+	lint-bench-records lint-dashboards lint-chaos-scenarios chaos-smoke \
+	profile-smoke

@@ -618,6 +618,62 @@ def _bench_serving_load() -> dict:
     except Exception as exc:  # noqa: BLE001 — keep the WSGI arm's record
         out["fastlane_qps"] = {"error": repr(exc)[:300]}
 
+    # the profiler_overhead arm (ISSUE 17): the same open-loop schedule
+    # against a fresh fast-lane server, steady sampler off vs on at the
+    # default ~99 Hz — the end-to-end p50 cost of always-on stack
+    # sampling, landed as server_load_profiler_overhead_pct and gated
+    # <= 3% by scripts/bench_compare.py. Failure here must not cost the
+    # section the arms already measured.
+    try:
+        from gordo_tpu.observability import profiler
+
+        fl_server = fastlane.make_server(app, host="127.0.0.1", port=0)
+        threading.Thread(
+            target=fl_server.serve_forever, daemon=True
+        ).start()
+        prof_host = f"http://127.0.0.1:{fl_server.server_port}"
+        try:
+            off = load_test.run(
+                host=prof_host, project="bench", machine=machine_out.name,
+                mode="qps", qps=qps, users=users, duration=duration,
+                warmup=warmup, samples=100, flight=False,
+            )
+            saved_hz = os.environ.get("GORDO_TPU_PROFILE_HZ")
+            os.environ["GORDO_TPU_PROFILE_HZ"] = str(profiler.DEFAULT_HZ)
+            try:
+                profiler.ensure_started()
+                on = load_test.run(
+                    host=prof_host, project="bench",
+                    machine=machine_out.name,
+                    mode="qps", qps=qps, users=users, duration=duration,
+                    warmup=warmup, samples=100, flight=False,
+                )
+            finally:
+                profiler.stop_steady()
+                if saved_hz is None:
+                    os.environ.pop("GORDO_TPU_PROFILE_HZ", None)
+                else:
+                    os.environ["GORDO_TPU_PROFILE_HZ"] = saved_hz
+            p50_off = off.get("p50_ms")
+            p50_on = on.get("p50_ms")
+            out["profiler_overhead"] = {
+                "p50_off_ms": p50_off,
+                "p50_on_ms": p50_on,
+                "p99_off_ms": off.get("p99_ms"),
+                "p99_on_ms": on.get("p99_ms"),
+                "hz": profiler.DEFAULT_HZ,
+                "samples": profiler.snapshot(top=0)["total_samples"],
+                "overhead_pct": (
+                    (p50_on - p50_off) / p50_off * 100.0
+                    if p50_off and p50_on is not None else None
+                ),
+            }
+        finally:
+            fl_server.server_close()
+    except Exception as exc:  # noqa: BLE001 — keep the measured arms
+        out["profiler_overhead"] = {"error": repr(exc)[:300]}
+    emit_partial(out)
+
     # the serving_gateway arm (ISSUE 12): the SAME collection behind two
     # lease-registered fast-lane nodes and one consistent-hash gateway —
     # routed-vs-direct overhead plus the kill-a-node recovery time.
@@ -2493,6 +2549,12 @@ def _emit_record(sections: dict, recovered: list):
         "server_load_trace_compiles_steady": load_fastlane.get(
             "trace_compiles_steady"
         ),
+        # steady-sampler cost on the serving path (ISSUE 17): p50 delta
+        # between a profiler-on and profiler-off run of the same schedule,
+        # as a percentage — bench_compare gates this at <= 3% absolute
+        "server_load_profiler_overhead_pct": (
+            load_res.get("profiler_overhead") or {}
+        ).get("overhead_pct"),
         # the cross-node gateway arm of the same open-loop schedule
         # (ISSUE 12): routed percentiles, the overhead over the direct
         # fast-lane arm, and the kill-a-node recovery time (absent in
@@ -2518,6 +2580,13 @@ def _emit_record(sections: dict, recovered: list):
             "platform": serving_load.get("platform"),
             "qps_target": load_qps.get("qps_target"),
             "errors": load_qps.get("errors"),
+            # per-phase percentiles of the open-loop arm (ISSUE 17) so
+            # bench_compare --explain can decompose a p99 delta between
+            # two records without re-reading raw detail sidecars
+            "p50_ms": load_qps.get("p50_ms"),
+            "p99_ms": load_qps.get("p99_ms"),
+            "phases": load_qps.get("phases"),
+            "profiler_overhead": load_res.get("profiler_overhead"),
             "fastlane_errors": load_fastlane.get("errors"),
             "fastlane_event_loop": load_fastlane.get("event_loop"),
             "gateway_errors": load_gateway.get("errors"),

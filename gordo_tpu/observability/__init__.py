@@ -8,6 +8,7 @@ from gordo_tpu.observability.grafana import (  # noqa: F401
     fleet_dashboard,
     gateway_dashboard,
     machines_dashboard,
+    perf_dashboard,
     resilience_dashboard,
     servers_dashboard,
     write_dashboards,

@@ -39,6 +39,7 @@ DEFAULT_CAPACITY = 64
 _SAMPLE_WINDOW = 512
 _MIN_SAMPLES = 50
 _ADAPTIVE_FLOOR_S = 0.25
+_EVENT_CAPACITY = 16
 
 
 def capacity_from_env() -> int:
@@ -75,6 +76,9 @@ class FlightRecorder:
             maxlen=max(1, capacity - error_cap)
         )
         self._durations: "deque[float]" = deque(maxlen=_SAMPLE_WINDOW)
+        # out-of-band events (perf-sentinel fires, etc.): small bounded
+        # ring, never evicted by request traces
+        self._events: "deque[Dict[str, Any]]" = deque(maxlen=_EVENT_CAPACITY)
         self._t0 = time.monotonic()
         self.seen = 0
         self.kept = 0
@@ -143,6 +147,30 @@ class FlightRecorder:
         metric_catalog.FLIGHT_OCCUPANCY.labels(cls="slow").set(n_slow)
         return verdict
 
+    def record_event(self, kind: str, payload: Dict[str, Any]) -> None:
+        """Attach an out-of-band event (e.g. a perf-sentinel fire with
+        its attribution snapshot and stack evidence) to the recorder so
+        /debug/flight carries it alongside the request traces."""
+        record = {
+            "kind": str(kind),
+            "recorded_at": time.time(),
+            "payload": payload,
+        }
+        with self._lock:
+            self._events.append(record)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def worst_trace(self) -> Optional[Dict[str, Any]]:
+        """The slowest kept trace (any class), or None when empty."""
+        with self._lock:
+            records = list(self._errors) + list(self._slow)
+        if not records:
+            return None
+        return max(records, key=lambda r: r["duration_s"])
+
     # ------------------------------------------------------------ export
     def snapshot(self) -> List[Dict[str, Any]]:
         """Kept traces, oldest first, errors and slow interleaved by
@@ -198,6 +226,7 @@ class FlightRecorder:
                 {k: v for k, v in record.items() if k != "spans"}
                 for record in records
             ],
+            "gordoEvents": self.events(),
         }
 
     def reset(self) -> None:
@@ -205,6 +234,7 @@ class FlightRecorder:
             self._errors.clear()
             self._slow.clear()
             self._durations.clear()
+            self._events.clear()
             self.seen = 0
             self.kept = 0
 

@@ -1233,6 +1233,121 @@ def chaos_dashboard() -> Dict[str, Any]:
     return _dashboard("Gordo TPU chaos drills", "gordo-tpu-chaos", panels)
 
 
+def perf_dashboard() -> Dict[str, Any]:
+    """Self-observing perf plane (ISSUE 17): the latency-attribution
+    gauge block, the perf-regression sentinel, and the sampling profiler
+    (observability/attribution.py, sentinel.py, profiler.py). Like the
+    drift dashboard these are telemetry-registry series without a
+    project label, so panels query unselected names."""
+    panels = [
+        _timeseries(
+            "Per-phase p99 latency",
+            [
+                {
+                    "expr": "max(gordo_server_phase_p99_seconds) "
+                    "by (phase)",
+                    "legend": "{{phase}}",
+                }
+            ],
+            panel_id=1,
+            x=0,
+            y=0,
+            unit="s",
+            description=(
+                "p99 of each serving phase (decode/predict/encode, the "
+                "derived in-server remainder, the client total) over the "
+                "current attribution window — the series /debug/perf "
+                "decomposes a headline move against"
+            ),
+        ),
+        _timeseries(
+            "Per-phase p50 latency",
+            [
+                {
+                    "expr": "max(gordo_server_phase_p50_seconds) "
+                    "by (phase)",
+                    "legend": "{{phase}}",
+                }
+            ],
+            panel_id=2,
+            x=_PANEL_W,
+            y=0,
+            unit="s",
+            description=(
+                "Median of each serving phase over the current "
+                "attribution window; a p99 move without a p50 move is a "
+                "tail problem, both moving is a throughput problem"
+            ),
+        ),
+        _timeseries(
+            "Perf-regression events by phase",
+            [
+                {
+                    "expr": "sum(rate(gordo_server_perf_regression_total"
+                    "[5m])) by (phase)",
+                    "legend": "{{phase}}",
+                }
+            ],
+            panel_id=3,
+            x=0,
+            y=_PANEL_H,
+            description=(
+                "Online sentinel fires: a phase's latency CUSUM crossed "
+                "GORDO_TPU_PERF_SENTINEL_THRESHOLD against its frozen "
+                "post-warmup baseline; each fire attaches the attribution "
+                "snapshot and top stacks to /debug/flight"
+            ),
+        ),
+        _timeseries(
+            "Sentinel CUSUM by phase",
+            [
+                {
+                    "expr": "max(gordo_server_perf_sentinel_cusum) "
+                    "by (phase)",
+                    "legend": "{{phase}}",
+                }
+            ],
+            panel_id=4,
+            x=_PANEL_W,
+            y=_PANEL_H,
+            description=(
+                "The accumulating one-sided CUSUM statistic per phase "
+                "(baseline sigma units): rising toward the threshold "
+                "means a persistent slowdown is building before it pages"
+            ),
+        ),
+        _timeseries(
+            "Profiler sample rate",
+            [
+                {
+                    "expr": "sum(rate("
+                    "gordo_server_profile_samples_total[5m]))",
+                    "legend": "samples/s",
+                }
+            ],
+            panel_id=5,
+            x=0,
+            y=2 * _PANEL_H,
+            description=(
+                "Stack samples folded per second by the sampling "
+                "profiler (GORDO_TPU_PROFILE_HZ steady ticks plus "
+                "/debug/profile bursts) — zero means the profiler is "
+                "off, a sag under load means the sampler is starved"
+            ),
+        ),
+        _stat(
+            "Regressions (1h)",
+            "sum(increase(gordo_server_perf_regression_total[1h]))",
+            panel_id=6,
+            x=_PANEL_W,
+            y=2 * _PANEL_H,
+        ),
+    ]
+    return _dashboard(
+        "Gordo TPU / Perf plane", "gordo-tpu-perf", panels
+    )
+
+
 def write_dashboards(out_dir: str) -> List[str]:
     """Write the dashboards as JSON files into ``out_dir``; returns paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -1246,6 +1361,7 @@ def write_dashboards(out_dir: str) -> List[str]:
         ("gordo_tpu_gateway.json", gateway_dashboard),
         ("gordo_tpu_drift.json", drift_dashboard),
         ("gordo_tpu_chaos.json", chaos_dashboard),
+        ("gordo_tpu_perf.json", perf_dashboard),
     ):
         path = os.path.join(out_dir, name)
         with open(path, "w") as fh:

@@ -477,6 +477,46 @@ HOT_SWAP_FAILURES = telemetry.counter(
     ("model",),
 )
 
+# ------------------------------------- self-observing perf plane (ISSUE 17)
+# wired by observability/profiler.py (sampling profiler),
+# observability/attribution.py (per-phase windows + gauges) and
+# observability/sentinel.py (online perf-regression CUSUM)
+PROFILE_SAMPLES = telemetry.counter(
+    "gordo_server_profile_samples_total",
+    "Stack samples folded by the sampling profiler (steady sampler ticks "
+    "at GORDO_TPU_PROFILE_HZ plus on-demand /debug/profile bursts), one "
+    "per registered hot thread per tick",
+)
+PERF_REGRESSIONS = telemetry.counter(
+    "gordo_server_perf_regression_total",
+    "Perf-regression events from the online sentinel: a serving phase's "
+    "latency CUSUM crossed GORDO_TPU_PERF_SENTINEL_THRESHOLD against its "
+    "post-warmup frozen baseline (one event per episode — hysteresis "
+    "suppresses repeats until cooldown)",
+    ("phase",),
+)
+PHASE_P50 = telemetry.gauge(
+    "gordo_server_phase_p50_seconds",
+    "Median latency of each serving phase (decode/predict/encode, the "
+    "derived in-server remainder, and the client total) over the current "
+    "attribution window",
+    ("phase",),
+)
+PHASE_P99 = telemetry.gauge(
+    "gordo_server_phase_p99_seconds",
+    "p99 latency of each serving phase over the current attribution "
+    "window (the per-phase series /debug/perf decomposes a headline "
+    "move against)",
+    ("phase",),
+)
+SENTINEL_CUSUM = telemetry.gauge(
+    "gordo_server_perf_sentinel_cusum",
+    "Current one-sided CUSUM statistic of each phase's perf-regression "
+    "detector, in baseline sigma units (fires at "
+    "GORDO_TPU_PERF_SENTINEL_THRESHOLD)",
+    ("phase",),
+)
+
 # --------------------------------------------------- chaos conductor
 CHAOS_ACTIONS = telemetry.counter(
     "gordo_server_chaos_actions_total",

@@ -871,6 +871,11 @@ class CrossModelBatcher:
                 self._thread.start()
 
     def _loop(self):
+        # the dispatcher is a named hot thread for the sampling profiler
+        # (no-op singleton unless a profiler/debug knob is set)
+        from gordo_tpu.observability import profiler
+
+        profiler.register_thread("gordo-batcher")
         while True:
             batch = [self._ring.pop_wait()]
             if self.window_s > 0:

@@ -28,6 +28,22 @@ nothing new by default):
   rolling-window summary; plus the merged fleet view when telemetry
   shards are active and the rebuild-queue depth when a drift queue is
   configured.
+- ``GET /debug/profile?seconds=N`` — on-demand burst capture from the
+  sampling profiler (observability/profiler.py): sample the registered
+  hot threads for N seconds (``hz=`` overrides the burst rate) and
+  return collapsed stacks, or ``format=chrome`` for a Chrome trace,
+  ``format=collapsed`` for plain text a flamegraph tool ingests
+  directly. Works whether or not the steady sampler
+  (``GORDO_TPU_PROFILE_HZ``) is running; ``steady=1`` returns the
+  steady sampler's accumulated view instead of capturing, and
+  ``device=1`` runs an on-demand ``jax.profiler`` device trace into
+  ``GORDO_TPU_PROFILE_DIR``.
+- ``GET /debug/perf`` — the latency-attribution engine's live view
+  (observability/attribution.py): per-phase window quantiles, the
+  current-vs-previous-window decomposition (which phase moved p50/p99
+  and by how much, plus the traffic mix-shift term), and the
+  perf-regression sentinel's per-phase CUSUM state
+  (observability/sentinel.py).
 - ``POST /debug/prewarm?machine=<name>[&revision=<rev>]`` — the one
   deliberate exception to read-only: run the warmup pre-registration
   (server/warmup.py — serving-program compiles, param-bank pinning, AOT
@@ -93,12 +109,86 @@ def dispatch(endpoint: str, config: Dict[str, Any], request=None) -> Response:
         return drift_view()
     if endpoint == "debug_prewarm":
         return prewarm_view(config, request)
+    if endpoint == "debug_profile":
+        return profile_view(request)
+    if endpoint == "debug_perf":
+        return perf_view()
     return config_view()
 
 
 # -------------------------------------------------------------- /debug/flight
 def flight_view() -> Response:
-    return _json(flight.default_recorder().chrome_trace())
+    """The flight ring as Chrome trace JSON, now with a ``gordoProfile``
+    sidecar: the steady profiler's collapsed stacks keyed to the worst
+    kept trace, so the evidence of *what the CPU was doing* ships next
+    to the evidence of *which requests were bad*."""
+    from gordo_tpu.observability import profiler
+
+    payload = flight.default_recorder().chrome_trace()
+    worst = flight.default_recorder().worst_trace()
+    payload["gordoProfile"] = {
+        "worst_trace": None if worst is None else {
+            "trace_id": worst["trace_id"],
+            "class": worst["class"],
+            "duration_s": worst["duration_s"],
+            "endpoint": worst["endpoint"],
+        },
+        "profile": profiler.snapshot(top=20),
+    }
+    return _json(payload)
+
+
+# ------------------------------------------------------------- /debug/profile
+def _float_arg(request, name: str, default: float) -> float:
+    if request is None:
+        return default
+    try:
+        return float(request.args.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def profile_view(request=None) -> Response:
+    """On-demand profiling surface (see module docstring). Burst capture
+    runs inline in the handling thread — the other lane's hot threads
+    keep serving while this request samples them."""
+    from gordo_tpu.observability import profiler
+
+    if request is not None and request.args.get("device") in ("1", "true"):
+        seconds = _float_arg(request, "seconds", 2.0)
+        return _json({"device_trace": profiler.device_trace(seconds)})
+
+    fmt = request.args.get("format", "json") if request is not None else "json"
+    if request is not None and request.args.get("steady") in ("1", "true"):
+        counter = profiler.steady_counter()
+    else:
+        seconds = _float_arg(request, "seconds", 2.0)
+        hz = _float_arg(request, "hz", profiler.DEFAULT_HZ)
+        counter = profiler.burst(seconds, hz=hz)
+    if fmt == "collapsed":
+        return Response(
+            "\n".join(counter.collapsed()) + "\n",
+            status=200, mimetype="text/plain",
+        )
+    if fmt == "chrome":
+        return _json(counter.chrome_trace(profiler.steady_hz()
+                                          or profiler.DEFAULT_HZ))
+    payload = counter.to_dict(top=100)
+    payload["steady"] = profiler.snapshot(top=0)
+    return _json(payload)
+
+
+# ---------------------------------------------------------------- /debug/perf
+def perf_view() -> Response:
+    """The live latency decomposition + sentinel state."""
+    from gordo_tpu.observability import attribution, sentinel
+
+    return _json(
+        {
+            "attribution": attribution.snapshot(),
+            "sentinel": sentinel.snapshot(),
+        }
+    )
 
 
 # ---------------------------------------------------------------- /debug/vars

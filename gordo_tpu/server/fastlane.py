@@ -56,7 +56,7 @@ try:
 except ImportError:  # pragma: no cover - environment-dependent
     from gordo_tpu.util import _simplejson as simplejson
 
-from gordo_tpu.observability import flight, telemetry, tracing
+from gordo_tpu.observability import flight, profiler, telemetry, tracing
 from gordo_tpu.observability import metrics as metric_catalog
 from gordo_tpu.server import fast_codec, resilience
 from gordo_tpu.server.server import RequestContext, observe_request_outcome
@@ -327,6 +327,7 @@ class FastLaneServer:
             "fast lane serving on port %d (hot routes socket-level, "
             "everything else via WSGI fallback)", self.server_port,
         )
+        profiler.register_thread("gordo-fastlane-accept")
         while not self._shutdown.is_set():
             try:
                 conn, _addr = self._sock.accept()
@@ -351,6 +352,10 @@ class FastLaneServer:
 
     # ----------------------------------------------------------- connection
     def _handle_connection(self, conn):
+        # per-connection worker: a hot thread while its connection lives
+        # (no-op singleton unless a profiler/debug knob is set; the
+        # profiler purges the ident once the thread exits)
+        profiler.register_thread("gordo-fastlane")
         conn.settimeout(self.request_timeout)
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -549,6 +554,7 @@ class FastLaneServer:
             observe_request_outcome(
                 rule, gordo_name, runtime_s, response.status,
                 slo_eligible=True,
+                phases=ctx.timings,
             )
             out_headers = [("Content-Type", response.mimetype)]
             out_headers.extend(response.headers.items())
@@ -714,6 +720,9 @@ class EventLoopServer(FastLaneServer):
             "socket-level, everything else via WSGI fallback)",
             self.server_port,
         )
+        # the event-loop lane IS the hot thread: every hot-route request
+        # decodes/predicts/encodes on this stack
+        profiler.register_thread("gordo-eventloop")
         sel = self._selector
         sel.register(self._sock, selectors.EVENT_READ, None)
         last_sweep = time.monotonic()

@@ -457,6 +457,11 @@ class GatewayServer(EventLoopServer):
 
     # -------------------------------------------------------- worker side
     def _worker_loop(self):
+        # proxy workers are named hot threads for the sampling profiler
+        # (no-op singleton unless a profiler/debug knob is set)
+        from gordo_tpu.observability import profiler
+
+        profiler.register_thread("gordo-gateway-worker")
         while True:
             job = self._jobs.get()
             if job is None:

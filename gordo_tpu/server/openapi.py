@@ -274,6 +274,35 @@ def openapi_document() -> dict:
                     },
                 }
             },
+            "/debug/profile": {
+                "get": {
+                    "summary": "Sampling profiler: burst-capture the "
+                    "registered hot threads for ?seconds=N (&hz= "
+                    "overrides the rate) and return collapsed stacks "
+                    "(&format=collapsed|chrome|json); &steady=1 returns "
+                    "the steady sampler's accumulated view, &device=1 "
+                    "runs a jax.profiler device trace; gated by "
+                    "GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "Collapsed-stack text, "
+                                "Chrome trace JSON, or sample summary"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
+            "/debug/perf": {
+                "get": {
+                    "summary": "Latency attribution: per-phase window "
+                    "quantiles, the live p50/p99 decomposition against "
+                    "the previous window (with mix-shift term), and the "
+                    "perf-regression sentinel's per-phase CUSUM state; "
+                    "gated by GORDO_TPU_DEBUG_ENDPOINTS",
+                    "responses": {
+                        "200": {"description": "{attribution, sentinel}"},
+                        "404": {"description": "Debug endpoints disabled"},
+                    },
+                }
+            },
             "/debug/prewarm": {
                 "post": {
                     "summary": "Warm the serving caches for one machine "

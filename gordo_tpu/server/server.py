@@ -32,9 +32,11 @@ from werkzeug.wrappers import Request, Response
 
 from gordo_tpu import __version__
 from gordo_tpu.observability import (
+    attribution,
     drift,
     flight,
     metrics as metric_catalog,
+    sentinel,
     shared,
     slo,
     telemetry,
@@ -53,12 +55,16 @@ _GATED_ENDPOINTS = ("base_prediction", "anomaly_prediction")
 def observe_request_outcome(
     rule: str, model: str, duration_s: float, status: int,
     slo_eligible: bool = False,
+    phases: Optional[Dict[str, float]] = None,
 ) -> None:
     """Per-request fleet/SLO feed, shared verbatim by the WSGI edge and the
     socket fast lane so the two lanes produce identical observability
     (pinned by tests/gordo_tpu/test_fastlane.py). Labels by the matched
     RULE and the status CLASS — both bounded — and flushes this process's
-    telemetry shard (throttled) so the fleet view stays fresh under load."""
+    telemetry shard (throttled) so the fleet view stays fresh under load.
+    ``phases`` (ctx.timings: decode/predict/encode wall seconds) feeds the
+    latency-attribution windows and the perf-regression sentinel, both of
+    which no-op before taking any lock when their knobs are unset."""
     try:
         status_class = f"{int(status) // 100}xx"
         metric_catalog.FLEET_REQUESTS.labels(
@@ -69,6 +75,9 @@ def observe_request_outcome(
         ).observe(duration_s)
         if slo_eligible and model:
             slo.record(model, duration_s, status)
+        if slo_eligible and status < 400:
+            attribution.observe(model, duration_s, phases)
+            sentinel.observe_phases(duration_s, phases)
         shared.flush()
     except Exception:  # noqa: BLE001 — observability must not fail requests
         logger.debug("request observability feed failed", exc_info=True)
@@ -199,6 +208,8 @@ class GordoServer:
             Rule("/debug/slo", endpoint="debug_slo"),
             Rule("/debug/drift", endpoint="debug_drift"),
             Rule("/debug/prewarm", endpoint="debug_prewarm"),
+            Rule("/debug/profile", endpoint="debug_profile"),
+            Rule("/debug/perf", endpoint="debug_perf"),
             Rule("/gordo/v0/openapi.json", endpoint="openapi_spec"),
             Rule(
                 "/gordo/v0/<gordo_project>/models",
@@ -258,6 +269,10 @@ class GordoServer:
         # drift detector windows ride the same shard flushes (no-op until
         # GORDO_TPU_DRIFT_DETECT records anything)
         drift.install_shard_hooks()
+        # latency-attribution windows + perf-sentinel gauges likewise
+        # (no-op until their knobs record anything)
+        attribution.install_shard_hooks()
+        sentinel.install_shard_hooks()
         self._prometheus = None
         if self.config["ENABLE_PROMETHEUS"]:
             from gordo_tpu.server.prometheus.metrics import (
@@ -437,6 +452,7 @@ class GordoServer:
             # them the same way on both lanes
             slo_eligible=bool(matched_rule)
             and matched_rule.endswith("/prediction"),
+            phases=ctx.timings,
         )
         return response
 

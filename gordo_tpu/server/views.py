@@ -441,6 +441,7 @@ def base_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
     )
 
     with ctx.phase("encode"):
+        faults.fault_point("serve_encode", machine=gordo_name)
         data = model_utils.make_base_raw(
             tags=mc.tags,
             model_input=X.values if isinstance(X, pd.DataFrame) else X,
@@ -535,6 +536,7 @@ def anomaly_prediction_core(ctx, request, gordo_name: str) -> PlainResponse:
     _record_drift_stat(gordo_name, lambda: _anomaly_total_stat(anomaly_df))
 
     with ctx.phase("encode"):
+        faults.fault_point("serve_encode", machine=gordo_name)
         is_raw = isinstance(anomaly_df, model_utils.RawFrame)
         if request.args.get("all_columns") is None:
             tops = (

@@ -62,10 +62,13 @@ in the server's model-load path (machine = model name),
 ``serve_predict`` in the request handler before the model's predict
 (supports ``wedge``), ``serve_device_call`` at the top of every fused
 device call in the cross-model batcher (machine matched against the fused
-group's members; supports ``wedge``), and ``serve_poison_nan`` NaN-poisons
+group's members; supports ``wedge``), ``serve_poison_nan`` NaN-poisons
 the request's feature matrix before predict (pair with
 ``GORDO_TPU_VALIDATE_OUTPUT=1`` to turn the poisoned lane into a typed
-failure).
+failure), and ``serve_encode`` fires inside the response-encode phase of
+both prediction cores (machine = model name; supports ``wedge`` — the
+deterministic encode-phase slowdown the perf-regression sentinel's e2e
+test injects, ISSUE 17).
 
 Elastic-scheduler site (ISSUE 10, parallel/batch_trainer.py):
 ``scheduler_lease`` fires right after a host acquires a lease on a work
@@ -141,7 +144,7 @@ KNOWN_SITES = (
     "scheduler_lease",
     # serve plane
     "serve_model_load", "serve_predict", "serve_device_call",
-    "serve_poison_nan",
+    "serve_poison_nan", "serve_encode",
     # gateway / membership plane
     "gateway_route", "node_partition", "node_dead", "lease_refresh",
     # drift loop

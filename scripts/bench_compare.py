@@ -34,6 +34,15 @@ comparable — the script says so and exits 0 unless ``--strict-platform``
 makes that an error: a CI runner falling back to CPU must not read as a
 10x regression.
 
+**Latency attribution** (ISSUE 17): ``--explain`` decomposes the
+``server_load_p99_ms`` (and p50) delta into per-phase contributions via
+``gordo_tpu.observability.attribution`` — the same budget-closing
+decomposition ``GET /debug/perf`` serves live. Records can be named by
+round shorthand (``r08`` resolves to ``BENCH_r08.json`` at the repo
+root). Any gate failure prints the decomposition automatically, so a
+"p99 regressed 18%" verdict always arrives with "and encode is the
+phase that did it".
+
 Exit codes: 0 = no regression (or not comparable), 1 = regression past
 ``--threshold`` (default 0.15 = 15%), 2 = a record is unusable (missing
 / unparseable / no ``parsed`` block). Wired into tier-1 by
@@ -45,8 +54,11 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 from typing import List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (key, higher_is_better)
 METRICS: Tuple[Tuple[str, bool], ...] = (
@@ -109,6 +121,14 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     ("abuse_flash_p99_ms", False),
     ("abuse_failover_s", False),
     ("abuse_error_burn", False),
+)
+
+# metrics gated on an ABSOLUTE ceiling of the NEW record alone (no
+# baseline needed): (key, max allowed value). The profiler-overhead
+# budget is "the steady sampler may cost at most 3% of serving p50" —
+# a property of one record, not a delta between two.
+ABSOLUTE_GATES: Tuple[Tuple[str, float], ...] = (
+    ("server_load_profiler_overhead_pct", 3.0),
 )
 
 # which harness section feeds each metric (schema v2 records carry a
@@ -214,7 +234,85 @@ def compare(
             f"{key}: {old_value:g} -> {new_value:g} "
             f"({delta * 100:+.1f}%) {verdict}"
         )
+    # absolute ceilings gate on the new record alone
+    for key, ceiling in ABSOLUTE_GATES:
+        section = metric_section(key, new)
+        status = section_status(new, section)
+        if status is not None and status != "completed":
+            lines.append(
+                f"{key}: skipped (section {section} is "
+                f"'{status}' in new record)"
+            )
+            continue
+        value = new.get(key)
+        if not isinstance(value, (int, float)):
+            lines.append(f"{key}: skipped (absent in new record)")
+            continue
+        verdict = "ok"
+        if value > ceiling:
+            verdict = "REGRESSION"
+            regressions.append(
+                f"{key}: {value:g} exceeds absolute ceiling {ceiling:g}"
+            )
+        lines.append(f"{key}: {value:g} (ceiling {ceiling:g}) {verdict}")
     return regressions, lines
+
+
+def resolve_record(arg: str) -> str:
+    """Map round shorthand (``r08``) to its ``BENCH_r08.json`` record —
+    in the current directory first, then at the repo root. Anything that
+    already names an existing path passes through untouched."""
+    if os.path.exists(arg) or not re.fullmatch(r"r\d+", arg):
+        return arg
+    for base in (os.getcwd(), REPO_ROOT):
+        candidate = os.path.join(base, f"BENCH_{arg}.json")
+        if os.path.exists(candidate):
+            return candidate
+    return arg
+
+
+def explain(old_path: str, new_path: str) -> None:
+    """Print the per-phase decomposition of the serving-load latency
+    delta between two records — the attribution engine's offline mode
+    (the online mode is ``GET /debug/perf`` on a live server)."""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    try:
+        from gordo_tpu.observability import attribution
+    except Exception as exc:  # noqa: BLE001 — explain is best-effort
+        print(f"explain unavailable (cannot import attribution): {exc}")
+        return
+    stats = []
+    for path in (old_path, new_path):
+        try:
+            with open(path) as fh:
+                record = json.load(fh)
+        except (OSError, ValueError):
+            record = {}
+        stats.append(
+            attribution.phase_stats_from_record(
+                record, base_dir=os.path.dirname(os.path.abspath(path))
+            )
+        )
+    base, cur = stats
+    if not base or not cur:
+        missing = [p for p, s in zip((old_path, new_path), stats) if not s]
+        print(
+            "explain: no per-phase serving stats recoverable from "
+            + ", ".join(missing)
+        )
+        return
+    for percentile in ("p50_ms", "p99_ms"):
+        decomp = attribution.decompose_stats(base, cur, percentile)
+        if decomp is None:
+            print(f"explain: {percentile} absent in one record")
+            continue
+        print(
+            "per-phase decomposition of the serving-load "
+            f"{percentile[:-3]} delta:"
+        )
+        for line in attribution.format_decomposition(decomp):
+            print(line)
 
 
 def latest_records(directory: str) -> List[str]:
@@ -245,6 +343,12 @@ def main(argv: List[str]) -> int:
         help="treat a platform mismatch (cpu vs tpu) as an error instead "
         "of 'not comparable, exit 0'",
     )
+    parser.add_argument(
+        "--explain",
+        action="store_true",
+        help="print the per-phase decomposition of the serving-load "
+        "latency delta (also printed automatically on any gate failure)",
+    )
     args = parser.parse_args(argv)
 
     if args.latest:
@@ -268,6 +372,8 @@ def main(argv: List[str]) -> int:
     else:
         if not args.old or not args.new:
             parser.error("need OLD and NEW records (or --latest DIR)")
+        args.old = resolve_record(args.old)
+        args.new = resolve_record(args.new)
         old = load_parsed(args.old)
         new = load_parsed(args.new)
         if old is None or new is None:
@@ -287,6 +393,8 @@ def main(argv: List[str]) -> int:
     print(f"comparing {args.old} -> {args.new} (platform {new_platform})")
     for line in lines:
         print(f"  {line}")
+    if args.explain or regressions:
+        explain(args.old, args.new)
     if regressions:
         print(f"{len(regressions)} regression(s) past threshold:")
         for line in regressions:

@@ -50,6 +50,10 @@ _NUMERIC_KEYS = (
     "server_load_fastlane_req_per_sec", "server_load_fastlane_p50_ms",
     "server_load_fastlane_p99_ms", "server_load_fastlane_p999_ms",
     "server_load_trace_compiles_steady",
+    # steady-sampler serving-path cost (ISSUE 17): p50 delta between a
+    # profiler-on and profiler-off run, as a percentage (gated <= 3%
+    # absolute by bench_compare.py)
+    "server_load_profiler_overhead_pct",
     # the cross-node serving gateway's arm of serving_load (ISSUE 12):
     # routed percentiles, overhead over the direct fast-lane arm, and
     # the kill-a-node recovery time

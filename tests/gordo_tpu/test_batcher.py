@@ -508,8 +508,12 @@ def test_fused_group_failure_isolates_poisoned_member(
     assert isinstance(items[1].error, faults.PermanentFault)
     for i in (0, 2):
         assert items[i].error is None
+        # bisection re-runs survivors at a different vmap width than the
+        # single-model predict; XLA does not promise bitwise-identical
+        # float32 across batch shapes, so compare at the same tolerance
+        # the auto-mode equivalence tests use (not 1e-6/1e-7, which flaked)
         np.testing.assert_allclose(
-            items[i].result, models[i].predict(X), rtol=1e-6, atol=1e-7
+            items[i].result, models[i].predict(X), rtol=1e-5, atol=1e-6
         )
     # [ok, P, ok] -> bisect into [ok] and [P, ok] -> bisect into [P], [ok]
     # -> P's singleton serial rescue also faults; exactly 2 bisections

@@ -10,6 +10,7 @@ from gordo_tpu.observability import (
     fleet_dashboard,
     gateway_dashboard,
     machines_dashboard,
+    perf_dashboard,
     resilience_dashboard,
     servers_dashboard,
     telemetry,
@@ -25,6 +26,7 @@ _ALL_DASHBOARDS = (
     resilience_dashboard,
     fleet_dashboard,
     gateway_dashboard,
+    perf_dashboard,
 )
 
 
@@ -97,7 +99,7 @@ def test_latency_panels_use_quantiles_not_averages():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 8
+    assert len(paths) == 9
     for path in paths:
         with open(path) as fh:
             dash = json.load(fh)
@@ -121,6 +123,7 @@ def test_checked_in_dashboards_are_current():
         ("gordo_tpu_gateway.json", gateway_dashboard),
         ("gordo_tpu_drift.json", drift_dashboard),
         ("gordo_tpu_chaos.json", chaos_dashboard),
+        ("gordo_tpu_perf.json", perf_dashboard),
     ):
         with open(os.path.join(out_dir, name)) as fh:
             assert json.load(fh) == build(), f"{name} is stale — regenerate with " \

@@ -1,0 +1,235 @@
+"""
+Sampling profiler (ISSUE 17, layer 1): disabled-path guarantees, burst
+capture, export formats, the gated debug endpoints, and the live
+profile-smoke subprocess (`make profile-smoke` wired into tier-1).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gordo_tpu.observability import profiler
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PROFILE_HZ", raising=False)
+    monkeypatch.delenv("GORDO_TPU_PROFILE_MAX_STACKS", raising=False)
+    monkeypatch.delenv("GORDO_TPU_DEBUG_ENDPOINTS", raising=False)
+    profiler.reset()
+    yield
+    profiler.reset()
+
+
+# ----------------------------------------------------------- disabled path
+def test_disabled_registration_is_shared_noop_singleton():
+    """With no profiler/debug knob set, register_thread must return THE
+    shared no-op handle — same object every call, zero state touched."""
+    reg_a = profiler.register_thread("lane-a")
+    reg_b = profiler.register_thread("lane-b")
+    assert reg_a is profiler.NOOP_REGISTRATION
+    assert reg_b is profiler.NOOP_REGISTRATION
+    assert profiler.registered_threads() == {}
+    assert not profiler.steady_running()
+    reg_a.unregister()  # harmless no-op
+
+
+def test_registration_armed_by_debug_endpoints_alone(monkeypatch):
+    """Burst capture through /debug/profile needs thread names even with
+    steady sampling off, so GORDO_TPU_DEBUG_ENDPOINTS arms registration —
+    but must NOT start the steady sampler."""
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    reg = profiler.register_thread("debug-armed")
+    assert reg is not profiler.NOOP_REGISTRATION
+    assert "debug-armed" in profiler.registered_threads().values()
+    assert not profiler.steady_running()
+    reg.unregister()
+    assert "debug-armed" not in profiler.registered_threads().values()
+
+
+def test_batcher_submit_adds_zero_observability_allocations(monkeypatch):
+    """Disabled-path micro-benchmark: with every ISSUE 17 knob unset, a
+    steady-state batcher submit must allocate NOTHING attributable to the
+    new observability modules (profiler/attribution/sentinel) — the
+    serving path is byte-identical to a build without them."""
+    import tracemalloc
+
+    from gordo_tpu.models.models import AutoEncoder
+    from gordo_tpu.observability import attribution, sentinel
+    from gordo_tpu.server.batcher import CrossModelBatcher
+
+    monkeypatch.delenv("GORDO_TPU_PERF_ATTRIBUTION", raising=False)
+    monkeypatch.delenv("GORDO_TPU_PERF_SENTINEL", raising=False)
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 4)
+    est = AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    est.fit(X, X)
+    b = CrossModelBatcher(window_ms=0, max_batch=8)
+    X32 = X.astype(np.float32)
+    # warm: compile the fused program, allocate stacking buffers, start
+    # the dispatcher loop (whose one register_thread call is the no-op)
+    b.submit(est.spec_, est.params_, X32)
+
+    module_files = (
+        profiler.__file__, attribution.__file__, sentinel.__file__,
+    )
+    filters = [tracemalloc.Filter(True, path) for path in module_files]
+    tracemalloc.start(5)
+    try:
+        for _ in range(5):
+            b.submit(est.spec_, est.params_, X32)
+            attribution.observe("m", 0.01, {"decode": 0.001})  # gated off
+        snapshot = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    stats = snapshot.statistics("lineno")
+    assert stats == [], [
+        (str(stat.traceback), stat.size) for stat in stats
+    ]
+
+
+# --------------------------------------------------------------- sampling
+def test_steady_sampler_samples_registered_thread(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_PROFILE_HZ", "250")
+    stop = threading.Event()
+
+    def spin():
+        profiler.register_thread("hot-spinner")
+        while not stop.is_set():
+            sum(range(500))
+
+    worker = threading.Thread(target=spin, daemon=True)
+    worker.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while (
+            profiler.steady_counter().total == 0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        worker.join(timeout=2)
+    snap = profiler.snapshot()
+    assert snap["running"]
+    assert snap["hz"] == 250
+    assert snap["total_samples"] > 0
+    assert any(
+        line.startswith("hot-spinner;") for line in snap["collapsed"]
+    )
+    assert profiler.top_stacks(5)
+
+
+def test_burst_captures_the_calling_registered_thread(monkeypatch):
+    """A burst requested FROM a registered thread (the event-loop lane
+    serving /debug/profile) must still capture that thread's own stack —
+    the sampling loop runs on a helper thread while the caller blocks."""
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    reg = profiler.register_thread("burst-caller")
+    try:
+        counter = profiler.burst(0.2, hz=300)
+    finally:
+        reg.unregister()
+    report = counter.to_dict()
+    assert report["total_samples"] > 0
+    assert any(
+        line.startswith("burst-caller;") for line in report["collapsed"]
+    )
+    # the caller's own frames are in the capture
+    assert any("test_profiler" in line for line in report["collapsed"])
+    # burst is independent of the steady sampler
+    assert not profiler.steady_running()
+
+
+# ------------------------------------------------------------ stack counter
+def test_stack_counter_overflow_stays_bounded():
+    counter = profiler.StackCounter(limit=16)
+    frame = sys._getframe()
+    for i in range(40):
+        counter.fold(f"thread-{i}", frame)
+    report = counter.to_dict()
+    assert report["total_samples"] == 40
+    # 16 distinct keys + the single overflow bucket
+    assert report["distinct_stacks"] == 17
+    assert report["overflow_samples"] == 24
+
+
+def test_collapsed_and_chrome_trace_formats():
+    counter = profiler.StackCounter(limit=64)
+    frame = sys._getframe()
+    for _ in range(3):
+        counter.fold("lane", frame)
+    lines = counter.collapsed()
+    assert len(lines) == 1
+    stack, count = lines[0].rsplit(" ", 1)
+    assert int(count) == 3
+    assert stack.startswith("lane;")
+    assert "test_profiler.py:" in stack
+
+    trace = counter.chrome_trace(hz=100.0)
+    (event,) = trace["traceEvents"]
+    assert event["ph"] == "X"
+    assert event["tid"] == "lane"
+    assert event["dur"] == pytest.approx(3 / 100.0 * 1e6)
+    assert event["args"]["samples"] == 3
+    assert trace["otherData"]["totalSamples"] == 3
+
+
+# --------------------------------------------------------- debug endpoints
+def test_profile_and_perf_endpoints_gated_then_live(tmp_path, monkeypatch):
+    from gordo_tpu.server import utils as server_utils
+    from gordo_tpu.server.server import build_app
+
+    server_utils.clear_model_caches()
+    app = build_app({"MODEL_COLLECTION_DIR": str(tmp_path)})
+    client = app.test_client()
+    # gated off: 404, indistinguishable from an unknown route
+    for path in ("/debug/profile", "/debug/perf"):
+        assert client.get(path).status_code == 404, path
+
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    resp = client.get("/debug/profile?seconds=0.05&hz=50")
+    assert resp.status_code == 200
+    body = resp.get_json()
+    assert "total_samples" in body
+    assert "steady" in body
+
+    resp = client.get("/debug/profile?seconds=0.05&hz=50&format=collapsed")
+    assert resp.status_code == 200
+    assert resp.mimetype == "text/plain"
+
+    resp = client.get("/debug/profile?seconds=0.05&hz=50&format=chrome")
+    assert "traceEvents" in resp.get_json()
+
+    body = client.get("/debug/perf").get_json()
+    assert "attribution" in body
+    assert "sentinel" in body
+
+
+# ------------------------------------------------------------ profile-smoke
+def test_profile_smoke_subprocess():
+    """`make profile-smoke` in miniature: the script boots a live
+    event-loop server, bursts /debug/profile, and must find the
+    event-loop frames in its own capture."""
+    env = dict(os.environ)
+    env["GORDO_TPU_PROFILE_SMOKE_SECONDS"] = "0.3"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "profile_smoke.py"),
+        ],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "profile-smoke: OK" in proc.stdout

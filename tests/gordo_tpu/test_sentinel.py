@@ -1,0 +1,242 @@
+"""
+Online perf-regression sentinel (ISSUE 17, layer 3): gated observe,
+baseline freeze, one-sided CUSUM fire with phase label, cooldown +
+hysteresis, and the acceptance e2e — a deterministic encode-phase
+slowdown (faults.py ``serve_encode`` wedge) under live fast-lane load
+makes the sentinel fire with phase="encode" and a flight-recorder event
+carrying the attribution snapshot plus a profile containing the slow
+frame.
+"""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from gordo_tpu.observability import attribution, flight, profiler, sentinel
+from gordo_tpu.util import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (
+        "GORDO_TPU_PERF_SENTINEL",
+        "GORDO_TPU_PERF_SENTINEL_THRESHOLD",
+        "GORDO_TPU_PERF_SENTINEL_MIN_SAMPLES",
+        "GORDO_TPU_PERF_SENTINEL_COOLDOWN_S",
+        "GORDO_TPU_PERF_ATTRIBUTION",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    sentinel.reset()
+    attribution.reset()
+    yield
+    sentinel.reset()
+    attribution.reset()
+
+
+def _arm(monkeypatch, min_samples=20, threshold=4.0, cooldown=300.0):
+    monkeypatch.setenv("GORDO_TPU_PERF_SENTINEL", "1")
+    monkeypatch.setenv(
+        "GORDO_TPU_PERF_SENTINEL_MIN_SAMPLES", str(min_samples)
+    )
+    monkeypatch.setenv("GORDO_TPU_PERF_SENTINEL_THRESHOLD", str(threshold))
+    monkeypatch.setenv(
+        "GORDO_TPU_PERF_SENTINEL_COOLDOWN_S", str(cooldown)
+    )
+
+
+def _baseline_phases(rng):
+    jitter = 1.0 + 0.02 * float(rng.standard_normal())
+    return 0.010 * jitter, {
+        "decode": 0.002 * jitter,
+        "predict": 0.004 * jitter,
+        "encode": 0.001 * jitter,
+    }
+
+
+def _feed_baseline(n=25, now=1000.0):
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        total, phases = _baseline_phases(rng)
+        assert sentinel.observe_phases(total, phases, now=now + i) == []
+
+
+_SLOW = (0.030, {"decode": 0.002, "predict": 0.004, "encode": 0.021})
+
+
+# ------------------------------------------------------------ disabled path
+def test_disabled_is_total_noop():
+    assert sentinel.observe_phases(0.010, {"decode": 0.002}) == []
+    snap = sentinel.snapshot()
+    assert snap["enabled"] is False
+    assert snap["phases"] == {}
+    assert sentinel.regressed_phases() == []
+
+
+# -------------------------------------------------------------- unit CUSUM
+def test_baseline_freezes_after_min_samples(monkeypatch):
+    _arm(monkeypatch, min_samples=20)
+    _feed_baseline(25)
+    snap = sentinel.snapshot()["phases"]
+    for phase in ("decode", "predict", "encode", "total", "server_other"):
+        assert snap[phase]["status"] == "ok", phase
+        assert snap[phase]["baseline_n"] == 20
+    assert snap["total"]["baseline_mean_ms"] == pytest.approx(10.0, rel=0.05)
+
+
+def test_fires_on_persistent_encode_slowdown(monkeypatch):
+    _arm(monkeypatch, min_samples=20)
+    _feed_baseline(25)
+    flight.default_recorder().reset()
+    fired = []
+    for i in range(20):
+        fired += sentinel.observe_phases(*_SLOW, now=1100.0 + i)
+    assert "encode" in fired
+    assert "total" in fired
+    # decode/predict held their baselines — no false positives
+    assert "decode" not in fired
+    assert "predict" not in fired
+    assert "encode" in sentinel.regressed_phases()
+    snap = sentinel.snapshot()["phases"]["encode"]
+    assert snap["status"] == "regressed"
+    assert snap["events"] == 1
+
+    # the evidence bundle landed on the flight recorder
+    events = [
+        e for e in flight.default_recorder().events()
+        if e["kind"] == "perf_regression"
+    ]
+    assert events
+    payloads = [e["payload"] for e in events]
+    encode_payload = next(p for p in payloads if p["phase"] == "encode")
+    assert encode_payload["observed_ms"] == pytest.approx(21.0)
+    assert "attribution" in encode_payload
+    assert "top_stacks" in encode_payload
+
+
+def test_cooldown_silences_then_rearms(monkeypatch):
+    _arm(monkeypatch, min_samples=20, cooldown=50.0)
+    _feed_baseline(25)
+    fired = []
+    for i in range(10):
+        fired += sentinel.observe_phases(*_SLOW, now=1100.0 + i)
+    assert fired.count("encode") == 1
+    # still slow inside the cooldown: silent (hysteresis)
+    fired_inside = []
+    for i in range(10):
+        fired_inside += sentinel.observe_phases(*_SLOW, now=1120.0 + i)
+    assert "encode" not in fired_inside
+    # past the cooldown: re-armed with a cleared statistic, fires again
+    fired_after = []
+    for i in range(10):
+        fired_after += sentinel.observe_phases(*_SLOW, now=1200.0 + i)
+    assert "encode" in fired_after
+    assert sentinel.snapshot()["phases"]["encode"]["events"] == 2
+
+
+def test_zero_mean_jitter_never_fires(monkeypatch):
+    _arm(monkeypatch, min_samples=20, threshold=8.0)
+    _feed_baseline(25)
+    rng = np.random.RandomState(7)
+    fired = []
+    for i in range(200):
+        total, phases = _baseline_phases(rng)
+        fired += sentinel.observe_phases(total, phases, now=1100.0 + i)
+    assert fired == []
+
+
+# ------------------------------------------------- the deterministic e2e
+def test_encode_slowdown_fires_sentinel_under_live_load_e2e(
+    model_collection_directory, trained_model_directories,
+    gordo_project, gordo_name, X_payload, monkeypatch,
+):
+    """ISSUE 17 acceptance: inject a deterministic encode-phase slowdown
+    (fault plan ``serve_encode`` wedge, armed only after the baseline is
+    frozen) under live fast-lane load; the sentinel must fire with
+    phase="encode" and the flight event must carry a profile whose
+    stacks contain the wedged frame."""
+    from gordo_tpu.server import build_app, fastlane
+    from gordo_tpu.server import utils as server_utils
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    baseline_n = 40
+    monkeypatch.setenv("GORDO_TPU_PERF_SENTINEL", "1")
+    monkeypatch.setenv(
+        "GORDO_TPU_PERF_SENTINEL_MIN_SAMPLES", str(baseline_n)
+    )
+    monkeypatch.setenv("GORDO_TPU_PERF_SENTINEL_THRESHOLD", "4")
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    monkeypatch.setenv("GORDO_TPU_PROFILE_HZ", "200")
+    monkeypatch.setenv(
+        faults.PLAN_ENV,
+        json.dumps({
+            "rules": [{
+                "site": "serve_encode",
+                "machine": gordo_name,
+                # arm after the baseline windows are comfortably frozen
+                "after": baseline_n + 5,
+                "times": -1,
+                "error": "wedge",
+                "seconds": 0.05,
+            }],
+        }),
+    )
+    faults.reset_plan()
+    profiler.reset()
+    flight.default_recorder().reset()
+    server_utils.clear_model_caches()
+
+    app = build_app({"MODEL_COLLECTION_DIR": model_collection_directory})
+    server = fastlane.EventLoopServer(app, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    body = json.dumps({"X": dataframe_to_dict(X_payload)}).encode()
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    try:
+        fired = False
+        for _ in range(baseline_n + 40):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.server_port, timeout=60
+            )
+            try:
+                conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200
+            finally:
+                conn.close()
+            if "encode" in sentinel.regressed_phases():
+                fired = True
+                break
+        assert fired, sentinel.snapshot()
+    finally:
+        server.server_close()
+        thread.join(timeout=5)
+        monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+        faults.reset_plan()
+        profiler.reset()
+
+    events = [
+        e for e in flight.default_recorder().events()
+        if e["kind"] == "perf_regression"
+    ]
+    encode_events = [
+        e for e in events if e["payload"]["phase"] == "encode"
+    ]
+    assert encode_events, events
+    payload = encode_events[0]["payload"]
+    # evidence bundle: which window moved...
+    assert payload["attribution"]["enabled"] is True
+    assert payload["observed_ms"] >= 50.0  # the injected wedge
+    # ...and what the hot thread was executing: the steady profiler's
+    # stacks at fire time contain the wedged encode frame
+    stacks = payload["top_stacks"]
+    assert stacks
+    assert any(
+        "faults.py" in stack or "views.py" in stack for stack in stacks
+    ), stacks
