@@ -40,6 +40,14 @@ render-gate:
 bench:
 	python bench.py
 
+# serving hot path only (ISSUE 19): the smoke + open-loop load sections —
+# fast-lane/UDS/gateway percentiles, syscalls per request, pipeline
+# overlaps — without the training-side sections. Minutes, not the full
+# harness; the partial record must NOT be committed as a BENCH_r*.json
+# round (bench-gate compares full rounds).
+bench-hotpath:
+	GORDO_TPU_BENCH_SECTIONS=tpu_smoke,serving_load python bench.py
+
 # hard perf regression gate: diff the two most recent BENCH_r*.json
 # records with comparable-section matching (exit 1 on a >15% regression;
 # see docs/benchmarking.md "Reading the gate")
@@ -73,6 +81,6 @@ chaos-smoke:
 profile-smoke:
 	JAX_PLATFORMS=cpu python scripts/profile_smoke.py
 
-.PHONY: image push test dryrun smoke render-gate bench bench-gate \
-	lint-bench-records lint-dashboards lint-chaos-scenarios chaos-smoke \
-	profile-smoke
+.PHONY: image push test dryrun smoke render-gate bench bench-hotpath \
+	bench-gate lint-bench-records lint-dashboards lint-chaos-scenarios \
+	chaos-smoke profile-smoke

@@ -600,8 +600,18 @@ def _bench_serving_load() -> dict:
         threading.Thread(
             target=fl_server.serve_forever, daemon=True
         ).start()
+        def fastlane_syscalls():
+            return sum(
+                metric_catalog.FASTLANE_SYSCALLS.value(op=op)
+                for op in ("recv", "send")
+            )
+
         try:
             trace_compiles_before = metric_catalog.TRACE_COMPILES.value()
+            syscalls_before = fastlane_syscalls()
+            overlaps_before = (
+                metric_catalog.DEVICE_PIPELINE_OVERLAPS.value()
+            )
             out["fastlane_qps"] = load_test.run(
                 host=f"http://127.0.0.1:{fl_server.server_port}",
                 project="bench", machine=machine_out.name,
@@ -612,11 +622,56 @@ def _bench_serving_load() -> dict:
                 metric_catalog.TRACE_COMPILES.value()
                 - trace_compiles_before
             )
+            # ISSUE 19 hot-path accounting over the measured arm: kernel
+            # round-trips per request (recv-coalescing + writev should
+            # hold this flat as payloads grow) and how many fused device
+            # calls dispatched while a predecessor was still in flight.
+            # The syscall denominator includes the warmup traffic and the
+            # priming request the counter also saw.
+            served = (
+                (out["fastlane_qps"].get("requests") or 0)
+                + int(round(warmup * qps)) + 1
+            )
+            out["fastlane_qps"]["syscalls_per_req"] = round(
+                (fastlane_syscalls() - syscalls_before) / max(1, served), 2
+            )
+            out["fastlane_qps"]["pipeline_overlaps"] = (
+                metric_catalog.DEVICE_PIPELINE_OVERLAPS.value()
+                - overlaps_before
+            )
             out["fastlane_qps"]["event_loop"] = fastlane.event_loop_enabled()
         finally:
             fl_server.server_close()
     except Exception as exc:  # noqa: BLE001 — keep the WSGI arm's record
         out["fastlane_qps"] = {"error": repr(exc)[:300]}
+
+    # the UDS arm (ISSUE 19): the same open-loop schedule against a fresh
+    # fast-lane server listening on a Unix-domain socket, driven over that
+    # socket — what a co-located caller (the gateway on the same host)
+    # pays when it skips the loopback TCP stack. Failure here must not
+    # cost the section the arms already measured.
+    try:
+        uds_sock = os.path.join(
+            tempfile.mkdtemp(prefix="bench-uds-"), "node.sock"
+        )
+        fl_server = fastlane.make_server(
+            app, host="127.0.0.1", port=0, uds=uds_sock
+        )
+        threading.Thread(
+            target=fl_server.serve_forever, daemon=True
+        ).start()
+        try:
+            out["uds_qps"] = load_test.run(
+                host=f"http://127.0.0.1:{fl_server.server_port}",
+                project="bench", machine=machine_out.name,
+                mode="qps", qps=qps, users=users, duration=duration,
+                warmup=warmup, samples=100, flight=False, uds=uds_sock,
+            )
+        finally:
+            fl_server.server_close()
+    except Exception as exc:  # noqa: BLE001 — keep the TCP arms' record
+        out["uds_qps"] = {"error": repr(exc)[:300]}
+    emit_partial(out)
 
     # the profiler_overhead arm (ISSUE 17): the same open-loop schedule
     # against a fresh fast-lane server, steady sampler off vs on at the
@@ -724,14 +779,18 @@ def _bench_serving_gateway(collection, machine, load_test, qps, duration,
     gateway = None
     try:
         for i in range(2):
+            # each node also binds a Unix-domain lane and advertises it in
+            # its lease (ISSUE 19) — the gateway is co-located here, so
+            # the routed hop upstream rides UDS, not loopback TCP
             node = fastlane.make_server(
                 build_app({"MODEL_COLLECTION_DIR": collection}),
                 host="127.0.0.1", port=0,
+                uds=os.path.join(directory, f"node-{i}.sock"),
             )
             threading.Thread(target=node.serve_forever, daemon=True).start()
             registration = membership.NodeRegistration(
                 directory, f"127.0.0.1:{node.server_port}",
-                node_id=f"bench-node-{i}",
+                node_id=f"bench-node-{i}", uds=node.uds_path,
             )
             nodes.append((node, registration))
         gateway = gateway_mod.GatewayServer(directory)
@@ -747,6 +806,9 @@ def _bench_serving_gateway(collection, machine, load_test, qps, duration,
             warmup=warmup, samples=100, flight=False,
         )
         result["nodes"] = len(nodes)
+        result["uds_nodes"] = sum(
+            1 for node, _reg in nodes if node.uds_path
+        )
         if direct_p50_ms is not None and result.get("p50_ms") is not None:
             result["p50_overhead_ms"] = round(
                 result["p50_ms"] - direct_p50_ms, 3
@@ -1075,7 +1137,10 @@ SECTION_NAMES = (
 SECTION_STATUSES = (
     "completed", "skipped_for_budget", "failed", "timeout", "disabled",
 )
-RECORD_SCHEMA_VERSION = 6
+# v7: same section list as v6; adds the ISSUE-19 hot-path keys
+# (server_load_uds_*, server_load_syscalls_per_req,
+# server_load_pipeline_overlaps) to the flat record.
+RECORD_SCHEMA_VERSION = 7
 # Older records stay valid against the section list of THEIR schema
 # version (the record lint looks the version up here): a v2 record has no
 # fleet_build section and must not start failing when v3 adds one, nor a
@@ -1090,6 +1155,7 @@ SECTION_NAMES_BY_VERSION = {
     5: ("tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build", "drift_loop", "cold_start"),
     6: SECTION_NAMES,
+    7: SECTION_NAMES,
 }
 
 
@@ -2506,6 +2572,7 @@ def _emit_record(sections: dict, recovered: list):
     load_res = serving_load.get("result") or {}
     load_qps = load_res.get("qps") or {}
     load_fastlane = load_res.get("fastlane_qps") or {}
+    load_uds = load_res.get("uds_qps") or {}
     load_gateway = load_res.get("gateway") or {}
     load_fleet = load_res.get("fleet") or {}
     load_flight = load_qps.get("flight") or {}
@@ -2549,6 +2616,22 @@ def _emit_record(sections: dict, recovered: list):
         "server_load_trace_compiles_steady": load_fastlane.get(
             "trace_compiles_steady"
         ),
+        # the hot-path accounting of the same fast-lane arm (ISSUE 19):
+        # kernel round-trips per request (recv coalescing + writev must
+        # hold this flat) and fused device calls dispatched while a
+        # predecessor was still in flight (the device pipeline working)
+        "server_load_syscalls_per_req": load_fastlane.get(
+            "syscalls_per_req"
+        ),
+        "server_load_pipeline_overlaps": load_fastlane.get(
+            "pipeline_overlaps"
+        ),
+        # the Unix-domain lane (ISSUE 19): the same schedule over
+        # GORDO_TPU_UDS_PATH — the co-located caller's cost, no loopback
+        # TCP stack in the path
+        "server_load_uds_req_per_sec": load_uds.get("req_per_sec"),
+        "server_load_uds_p50_ms": load_uds.get("p50_ms"),
+        "server_load_uds_p99_ms": load_uds.get("p99_ms"),
         # steady-sampler cost on the serving path (ISSUE 17): p50 delta
         # between a profiler-on and profiler-off run of the same schedule,
         # as a percentage — bench_compare gates this at <= 3% absolute
@@ -2589,8 +2672,11 @@ def _emit_record(sections: dict, recovered: list):
             "profiler_overhead": load_res.get("profiler_overhead"),
             "fastlane_errors": load_fastlane.get("errors"),
             "fastlane_event_loop": load_fastlane.get("event_loop"),
+            "uds_errors": load_uds.get("errors"),
+            "uds_transport": load_uds.get("transport"),
             "gateway_errors": load_gateway.get("errors"),
             "gateway_nodes": load_gateway.get("nodes"),
+            "gateway_uds_nodes": load_gateway.get("uds_nodes"),
             "worst_traces": [
                 w.get("trace_id")
                 for w in (load_flight.get("worst_requests") or [])[:3]

@@ -42,6 +42,7 @@ Usage:
 
 import argparse
 import heapq
+import http.client
 import json
 import math
 import os
@@ -68,14 +69,50 @@ def _get_json(url: str):
         return json.loads(resp.read())
 
 
-def discover(host: str, project: str, machine: str = None):
-    """Learn target machine + its tags from the live server's own API."""
+class UDSHTTPConnection(http.client.HTTPConnection):
+    """``http.client`` dialing a Unix-domain socket path instead of
+    host:port — the client half of the server's ``GORDO_TPU_UDS_PATH``
+    lane. The nominal host is kept for Host headers only."""
+
+    def __init__(self, path: str, timeout: float = 60.0):
+        super().__init__("localhost", timeout=timeout)
+        self.uds_path = path
+
+    def connect(self):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self.uds_path)
+        self.sock = sock
+
+
+def _get_json_uds(uds_path: str, path: str):
+    conn = UDSHTTPConnection(uds_path, timeout=30.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status >= 400:
+            raise urllib.error.HTTPError(
+                path, resp.status, resp.reason, resp.headers, None
+            )
+        return json.loads(data)
+    finally:
+        conn.close()
+
+
+def discover(host: str, project: str, machine: str = None, get_json=None):
+    """Learn target machine + its tags from the live server's own API.
+    ``get_json`` (a ``path -> dict`` callable) swaps the transport — the
+    UDS lane passes one bound to the socket path."""
+    if get_json is None:
+        def get_json(path):
+            return _get_json(f"{host}{path}")
     if machine is None:
-        models = _get_json(f"{host}/gordo/v0/{project}/models")["models"]
+        models = get_json(f"/gordo/v0/{project}/models")["models"]
         if not models:
             raise SystemExit(f"no models under project {project!r}")
         machine = models[0]
-    meta = _get_json(f"{host}/gordo/v0/{project}/{machine}/metadata")
+    meta = get_json(f"/gordo/v0/{project}/{machine}/metadata")
     dataset = meta["metadata"]["dataset"]
     # same key fallback the server itself applies (server/views.py)
     raw_tags = dataset.get("tag_list") or dataset.get("tags") or []
@@ -120,6 +157,59 @@ def http_send_factory(url: str, body: bytes, headers: dict, timeout: float = 60.
             exc.close()
             return exc.code, trace_id, {}
         except Exception as exc:  # noqa: BLE001 — live-server bench, record+go on
+            return repr(exc)[:160], None, {}
+
+    return send
+
+
+def uds_send_factory(
+    uds_path: str, url_path: str, body: bytes, headers: dict,
+    timeout: float = 60.0,
+):
+    """Transport over the server's Unix-domain lane (``--uds``):
+    keep-alive connections pooled per worker thread (the gateway's
+    upstream-pool idiom), with one fresh-connection retry when a pooled
+    socket turns out stale (server restart, idle close). Same
+    ``(error, trace_id, phases)`` contract as ``http_send_factory``."""
+    local = threading.local()
+
+    def _drop():
+        conn = getattr(local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — already discarding it
+                pass
+            local.conn = None
+
+    def _once():
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = UDSHTTPConnection(uds_path, timeout=timeout)
+        conn.request("POST", url_path, body=body, headers=headers)
+        resp = conn.getresponse()
+        resp.read()
+        if resp.will_close:
+            _drop()
+        return resp
+
+    def send():
+        try:
+            try:
+                resp = _once()
+            except (OSError, http.client.HTTPException):
+                # a stale keep-alive socket is not a server error: one
+                # fresh-connection retry before recording anything
+                _drop()
+                resp = _once()
+            error = None if 200 <= resp.status < 300 else resp.status
+            return (
+                error,
+                resp.headers.get("X-Gordo-Trace"),
+                _parse_server_timing(resp.headers.get("Server-Timing")),
+            )
+        except Exception as exc:  # noqa: BLE001 — live-server bench, record+go on
+            _drop()
             return repr(exc)[:160], None, {}
 
     return send
@@ -900,20 +990,29 @@ def run(
     flight: bool = True, top_slow: int = DEFAULT_TOP_SLOW,
     processes: int = 1, shape: str = "flat", peak: float = 4.0,
     flash_at: float = None, flash_len: float = 1.0,
-    shard_dir: str = None, shards: int = 0, _send=None,
+    shard_dir: str = None, shards: int = 0, uds: str = None, _send=None,
 ) -> dict:
     """One full load run against a live server; returns the report dict.
-    ``_send`` injects a fake transport for tests."""
+    ``uds`` routes every request over the server's Unix-domain lane
+    (``GORDO_TPU_UDS_PATH``) instead of TCP. ``_send`` injects a fake
+    transport for tests."""
     import random
 
-    machine, tags = discover(host, project, machine)
+    get_json = (lambda path: _get_json_uds(uds, path)) if uds else None
+    machine, tags = discover(host, project, machine, get_json=get_json)
     X = [[random.random() for _ in tags] for _ in range(samples)]
     body = json.dumps({"X": X, "y": X}).encode()
-    url = f"{host}/gordo/v0/{project}/{machine}/anomaly/prediction"
+    url_path = f"/gordo/v0/{project}/{machine}/anomaly/prediction"
+    url = f"{host}{url_path}"
     headers = {"Content-Type": "application/json"}
     if codec:
         headers["X-Gordo-Codec"] = codec
-    send = _send or http_send_factory(url, body, headers)
+    if _send is not None:
+        send = _send
+    elif uds:
+        send = uds_send_factory(uds, url_path, body, headers)
+    else:
+        send = http_send_factory(url, body, headers)
 
     # one priming request outside any window so model-load/compile cost
     # lands nowhere near the measurement (legacy behavior, kept)
@@ -931,6 +1030,7 @@ def run(
         "users": users,
         "warmup_sec": warmup,
         "samples_per_request": samples,
+        "transport": "uds" if uds else "tcp",
     }
     if mode == "qps":
         if not qps or qps <= 0:
@@ -1081,6 +1181,12 @@ def main(argv=None) -> int:
         "--shards", type=int, default=0,
         help="total shard count the global schedule is sliced into",
     )
+    parser.add_argument(
+        "--uds", default=None, metavar="PATH",
+        help="route every request over the server's Unix-domain lane "
+        "(the GORDO_TPU_UDS_PATH the membership lease advertises) "
+        "instead of TCP — co-located callers skip the loopback stack",
+    )
     parser.add_argument("--samples", type=int, default=100)
     parser.add_argument(
         "--expected-interval-ms", type=float, default=None,
@@ -1118,7 +1224,7 @@ def main(argv=None) -> int:
         flight=not args.no_flight, top_slow=args.top_slow,
         processes=args.processes, shape=args.shape, peak=args.peak,
         flash_at=args.flash_at, flash_len=args.flash_len,
-        shard_dir=args.shard_dir, shards=args.shards,
+        shard_dir=args.shard_dir, shards=args.shards, uds=args.uds,
     )
     print(json.dumps(report))
     if "error" in report:

@@ -110,21 +110,46 @@ def _build() -> Optional[str]:
 
 
 def _builder_main() -> None:
-    """Daemon-thread body: one build attempt; a failure latches _load_failed
-    so callers stop stat-ing the cache and stay on the pandas path."""
+    """Daemon-thread body: one build attempt. A clean build failure
+    (compiler error, missing g++, timeout) latches _load_failed so callers
+    stop stat-ing the cache and stay on the pandas path; an unexpected
+    crash leaves the latch open so a later ``prebuild()``/``available()``
+    can retry (see _ensure_builder_thread)."""
     global _load_failed
-    if _build() is None:
+    try:
+        built = _build()
+    except Exception:  # noqa: BLE001 — a crashed builder must not latch
+        logger.warning("native builder thread crashed", exc_info=True)
+        return
+    if built is None:
         _load_failed = True
 
 
 def _ensure_builder_thread() -> threading.Thread:
-    """Start (at most once per process) the background builder thread."""
+    """Start (at most one at a time per process) the background builder.
+
+    Blocking callers (``prebuild(block=True)``) always receive the thread
+    that is actually building — including one started earlier by a
+    non-blocking ``available()`` call — so the in-flight compile is joined,
+    never duplicated. A builder that died WITHOUT latching ``_load_failed``
+    and without landing the artifact (a crash, not a compile failure) is
+    replaced, so one freak failure doesn't permanently pin the process to
+    the fallback path with nothing recorded."""
     global _builder_thread
     with _lock:
-        if _builder_thread is None:
-            _builder_thread = threading.Thread(target=_builder_main, daemon=True)
-            _builder_thread.start()
-        return _builder_thread
+        thread = _builder_thread
+        if (
+            thread is not None
+            and not thread.is_alive()
+            and not _load_failed
+            and not os.path.exists(_so_path())
+        ):
+            thread = None  # crashed builder: no artifact, no latch — retry
+        if thread is None:
+            thread = threading.Thread(target=_builder_main, daemon=True)
+            _builder_thread = thread
+            thread.start()
+        return thread
 
 
 def prebuild(block: bool = True) -> bool:
@@ -202,6 +227,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.gordo_parse_body_cols.restype = ctypes.c_int32
+        lib.gordo_parse_body_cols.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
         ]
@@ -333,6 +372,68 @@ def parse_xy(body: bytes):
     if yshape[0] >= 0:
         y = ybuf[: yshape[0] * yshape[1]].reshape(yshape[0], yshape[1])
     return X, y
+
+
+def parse_columns(body: bytes):
+    """
+    Strict one-pass parse of a flat column-dict request body
+    ``{"X": {name: {key: num, ...}, ...}}`` (``"y"`` absent or null)
+    straight into a float64 matrix — no json.loads, no per-cell Python
+    objects. Returns ``(values, names, keys)`` where ``values`` is the
+    (n_rows, n_cols) array in payload column order and ``names``/``keys``
+    are the column/index strings, or None when the body doesn't match the
+    strict grammar (shared key sequence across columns, no escaped
+    spellings, no duplicates) — the caller then falls back to the
+    json.loads path, which is always parity-safe.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if not isinstance(body, bytes):
+        body = bytes(body)
+    n = len(body)
+    # every cell costs >= 6 body bytes ('"k":1,'), and every key/name token
+    # at least 3 ('"k"') — generous capacity bounds either way
+    cap = n // 4 + 8
+    vals = np.empty(cap, dtype=np.float64)
+    key_off = np.empty(cap, dtype=np.int64)
+    key_len = np.empty(cap, dtype=np.int32)
+    name_off = np.empty(cap, dtype=np.int64)
+    name_len = np.empty(cap, dtype=np.int32)
+    shape = (ctypes.c_int64 * 2)()
+    rc = lib.gordo_parse_body_cols(
+        body,
+        n,
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cap,
+        key_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        key_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cap,
+        name_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        name_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cap,
+        shape,
+    )
+    if rc != 1:
+        return None
+    rows, cols = shape[0], shape[1]
+    # values were filled column-by-column: reshape + transpose is a view,
+    # no copy — the frame reads it as (n_rows, n_cols)
+    arr = vals[: rows * cols].reshape(cols, rows).T
+    try:
+        names = [
+            body[name_off[c]: name_off[c] + name_len[c]].decode("utf-8")
+            for c in range(cols)
+        ]
+        keys = [
+            body[key_off[r]: key_off[r] + key_len[r]].decode("utf-8")
+            for r in range(rows)
+        ]
+    except UnicodeDecodeError:
+        # json.loads would have raised too, but let the Python path be the
+        # one that turns this into a client-visible error
+        return None
+    return arr, names, keys
 
 
 def encode_template(

@@ -22,6 +22,8 @@
 #include <cstdlib>
 #include <cstring>
 #include <limits>
+#include <string_view>
+#include <unordered_set>
 #include <vector>
 
 // CPython's shortest-repr digit generator (the David Gay dtoa behind
@@ -315,6 +317,130 @@ const char* parse_matrix(const char* p, const char* end, double* out,
       continue;
     }
     if (*p == ']') {
+      ++p;
+      break;
+    }
+    return nullptr;
+  }
+  shape[0] = rows;
+  shape[1] = cols;
+  return p;
+}
+
+// One JSON string token with NO escapes and no raw control bytes: returns the
+// position past the closing quote and records the content span. Escaped
+// spellings ("A") would need full JSON string semantics to match
+// json.loads — those bail to the Python path (nullptr), which is always
+// parity-safe. Raw UTF-8 passes through: the Python side decodes the span
+// exactly as json.loads would.
+inline const char* parse_plain_string(const char* p, const char* end,
+                                      const char** tok_start,
+                                      const char** tok_end) {
+  if (p >= end || *p != '"') return nullptr;
+  ++p;
+  const char* s = p;
+  while (p < end) {
+    const unsigned char c = static_cast<unsigned char>(*p);
+    if (c == '"') {
+      *tok_start = s;
+      *tok_end = p;
+      return p + 1;
+    }
+    if (c == '\\' || c < 0x20) return nullptr;
+    ++p;
+  }
+  return nullptr;
+}
+
+// {name: {key: num, ...}, ...} (the dataframe_to_dict flat column shape)
+// into column-major `out` plus token spans for the index keys (first
+// column's, as offsets into the body) and the column names. Every column
+// must carry the byte-identical key sequence — ragged or reordered columns
+// take the pandas label-alignment path. Duplicate names/keys would collapse
+// in json.loads (last wins), so they bail too. Returns the position past
+// the closing '}', or nullptr for fallback.
+const char* parse_coldict(const char* base, const char* p, const char* end,
+                          double* out, int64_t cap, int64_t* key_off,
+                          int32_t* key_len, int64_t key_cap, int64_t* name_off,
+                          int32_t* name_len, int64_t name_cap, int64_t* shape) {
+  p = skip_ws(p, end);
+  if (p >= end || *p != '{') return nullptr;
+  ++p;
+  p = skip_ws(p, end);
+  if (p < end && *p == '}') return nullptr;  // empty dict
+  std::unordered_set<std::string_view> seen_keys;
+  int64_t rows = -1, cols = 0, total = 0;
+  while (true) {
+    p = skip_ws(p, end);
+    const char *ns, *ne;
+    p = parse_plain_string(p, end, &ns, &ne);
+    if (p == nullptr) return nullptr;
+    if (cols >= name_cap) return nullptr;
+    for (int64_t c = 0; c < cols; ++c) {
+      if (name_len[c] == ne - ns &&
+          std::memcmp(base + name_off[c], ns, ne - ns) == 0)
+        return nullptr;  // duplicate column name
+    }
+    name_off[cols] = ns - base;
+    name_len[cols] = static_cast<int32_t>(ne - ns);
+    p = skip_ws(p, end);
+    if (p >= end || *p != ':') return nullptr;
+    ++p;
+    p = skip_ws(p, end);
+    if (p >= end || *p != '{') return nullptr;
+    ++p;
+    p = skip_ws(p, end);
+    if (p < end && *p == '}') return nullptr;  // empty column
+    int64_t r = 0;
+    while (true) {
+      p = skip_ws(p, end);
+      const char *ks, *ke;
+      p = parse_plain_string(p, end, &ks, &ke);
+      if (p == nullptr) return nullptr;
+      if (cols == 0) {
+        if (r >= key_cap) return nullptr;
+        if (!seen_keys.emplace(ks, static_cast<size_t>(ke - ks)).second)
+          return nullptr;  // duplicate index key
+        key_off[r] = ks - base;
+        key_len[r] = static_cast<int32_t>(ke - ks);
+      } else if (r >= rows || key_len[r] != ke - ks ||
+                 std::memcmp(base + key_off[r], ks, ke - ks) != 0) {
+        return nullptr;
+      }
+      p = skip_ws(p, end);
+      if (p >= end || *p != ':') return nullptr;
+      ++p;
+      p = skip_ws(p, end);
+      if (total >= cap) return nullptr;
+      p = parse_num(p, end, &out[total]);
+      if (p == nullptr) return nullptr;
+      ++total;
+      ++r;
+      p = skip_ws(p, end);
+      if (p >= end) return nullptr;
+      if (*p == ',') {
+        ++p;
+        continue;
+      }
+      if (*p == '}') {
+        ++p;
+        break;
+      }
+      return nullptr;
+    }
+    if (rows < 0) {
+      rows = r;
+    } else if (r != rows) {
+      return nullptr;
+    }
+    ++cols;
+    p = skip_ws(p, end);
+    if (p >= end) return nullptr;
+    if (*p == ',') {
+      ++p;
+      continue;
+    }
+    if (*p == '}') {
       ++p;
       break;
     }
@@ -669,6 +795,67 @@ int32_t gordo_parse_xy(const char* s, int64_t n, double* xout, int64_t xcap,
     return 0;
   }
   if (!have_x || xshape[0] < 0) return 0;
+  p = skip_ws(p, end);
+  return p == end ? 1 : 0;
+}
+
+// Parse a prediction request body of exactly the form
+// {"X": {name: {key: num, ...}, ...}} — the flat column-dict shape
+// dataframe_to_dict emits — into a column-major float64 buffer plus token
+// spans (offsets into the body) for the shared index keys and the column
+// names. "y" may appear only as null (a column-dict y falls back to the
+// Python path). Any other structure returns 0 and the caller falls back to
+// json.loads. Returns 1 on success.
+int32_t gordo_parse_body_cols(const char* s, int64_t n, double* out,
+                              int64_t cap, int64_t* key_off, int32_t* key_len,
+                              int64_t key_cap, int64_t* name_off,
+                              int32_t* name_len, int64_t name_cap,
+                              int64_t* shape) {
+  shape[0] = -1;
+  shape[1] = -1;
+  const char* end = s + n;
+  const char* p = skip_ws(s, end);
+  if (p >= end || *p != '{') return 0;
+  ++p;
+  bool have_x = false, have_y = false;
+  while (true) {
+    p = skip_ws(p, end);
+    if (p + 3 > end || *p != '"' || p[2] != '"') return 0;
+    const char key = p[1];
+    if (key != 'X' && key != 'y') return 0;
+    p += 3;
+    p = skip_ws(p, end);
+    if (p >= end || *p != ':') return 0;
+    ++p;
+    if (key == 'X') {
+      if (have_x) return 0;
+      have_x = true;
+      p = parse_coldict(s, p, end, out, cap, key_off, key_len, key_cap,
+                        name_off, name_len, name_cap, shape);
+      if (p == nullptr) return 0;
+    } else {
+      if (have_y) return 0;
+      have_y = true;
+      p = skip_ws(p, end);
+      if (end - p >= 4 && std::memcmp(p, "null", 4) == 0) {
+        p += 4;
+      } else {
+        return 0;
+      }
+    }
+    p = skip_ws(p, end);
+    if (p >= end) return 0;
+    if (*p == ',') {
+      ++p;
+      continue;
+    }
+    if (*p == '}') {
+      ++p;
+      break;
+    }
+    return 0;
+  }
+  if (!have_x || shape[0] < 0) return 0;
   p = skip_ws(p, end);
   return p == end ? 1 : 0;
 }

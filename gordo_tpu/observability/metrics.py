@@ -223,6 +223,15 @@ FASTLANE_IDLE_CLOSES = telemetry.counter(
     "lane socket timeout); mid-request stalls are governed separately by "
     "the request timeout",
 )
+FASTLANE_SYSCALLS = telemetry.counter(
+    "gordo_server_fastlane_syscalls_total",
+    "Socket syscalls issued by the event-loop fast lane, by op (recv: one "
+    "per coalesced read; send: one per flush write — a vectored sendmsg "
+    "covering a whole pipelined burst counts once). The numerator of the "
+    "bench's syscalls-per-request key: writev batching should hold sends "
+    "at O(1) per readiness event, not O(k) for a k-deep pipeline",
+    ("op",),
+)
 TRACE_COMPILES = telemetry.counter(
     "gordo_server_trace_compiles_total",
     "jit trace+compile events in the serving path (incremented inside the "
@@ -326,6 +335,14 @@ DEVICE_MEMORY = telemetry.gauge(
     "bytes_limit) per local device; absent on backends without "
     "memory_stats (CPU)",
     ("device", "stat"),
+)
+DEVICE_PIPELINE_OVERLAPS = telemetry.counter(
+    "gordo_server_device_pipeline_overlaps_total",
+    "Fused device calls the batcher dispatched while a previous call's "
+    "results were still in flight (GORDO_TPU_DEVICE_PIPELINE): each count "
+    "is a drain (D2H + fan-out) that overlapped the next call's stage + "
+    "compute instead of serializing after it — 0 under strict-serial "
+    "fallback or an idle lane, climbing toward one-per-call under load",
 )
 PARAM_BANK_BYTES = telemetry.gauge(
     "gordo_server_param_bank_bytes",

@@ -51,6 +51,19 @@ from gordo_tpu.observability import metrics as metric_catalog
 logger = logging.getLogger(__name__)
 
 
+def device_pipeline_enabled() -> bool:
+    """``GORDO_TPU_DEVICE_PIPELINE`` gate (default on): the dispatcher
+    overlaps the drain (blocking D2H + per-rider fan-out) of fused call N
+    with the stage + async dispatch of call N+1, so the device starts the
+    next batch while the host is still unpacking the last one. The
+    staging buffers are double-buffered for exactly this (see
+    ``_stacked_inputs``). Set to 0 for the strict-serial device path
+    (results are byte-identical either way — only the overlap changes)."""
+    return os.environ.get(
+        "GORDO_TPU_DEVICE_PIPELINE", "1"
+    ).lower() not in ("0", "false", "no")
+
+
 @dataclass
 class _Item:
     spec: Any
@@ -489,11 +502,23 @@ class CrossModelBatcher:
         }
         # observability: exposed through /healthcheck-adjacent metrics and
         # asserted by tests
-        self.stats = {"items": 0, "device_calls": 0, "largest_batch": 0}
+        self.stats = {
+            "items": 0, "device_calls": 0, "largest_batch": 0,
+            "pipeline_overlaps": 0,
+        }
         # monotonic start of the device call the dispatcher is currently
         # inside (None between calls): the device-watchdog signal
         # (resilience.stuck_device_call_s -> /healthcheck 503)
         self._busy_since: Optional[float] = None
+        # device-path pipelining (ISSUE 19): overlap drain of call N with
+        # stage+dispatch of call N+1. Only meaningful in work-conserving
+        # mode (window_s == 0) — a timed window blocks in pop_wait, so the
+        # loop settles any in-flight call before opening one.
+        self._pipeline = device_pipeline_enabled()
+        # wall-clock end of the last drained call: busy-seconds for
+        # overlapping pipelined calls are unioned against this so the
+        # device duty-cycle gauge stays a true wall-clock fraction
+        self._last_drain_end = 0.0
 
     # ------------------------------------------------------------- public
     def decision_counts(self) -> Tuple[int, int]:
@@ -876,10 +901,28 @@ class CrossModelBatcher:
         from gordo_tpu.observability import profiler
 
         profiler.register_thread("gordo-batcher")
+        # the fused call dispatched but not yet drained (device-path
+        # pipelining, depth 1): its D2H + fan-out run AFTER the next
+        # batch's stage + dispatch, so the device computes while the host
+        # unpacks. Depth 1 matches the double-buffered staging arrays —
+        # a buffer is never refilled before its call has drained.
+        pending = None
         while True:
-            batch = [self._ring.pop_wait()]
+            if pending is not None:
+                nxt = self._ring.pop()
+                if nxt is None:
+                    # nothing queued behind the in-flight call: settle it
+                    # now — pipelining never delays an idle ring's result
+                    self._drain_call(pending)
+                    pending = None
+                    self._busy_since = None
+                    continue
+                batch = [nxt]
+            else:
+                batch = [self._ring.pop_wait()]
             if self.window_s > 0:
-                # optional timed collection window (off by default)
+                # optional timed collection window (off by default); the
+                # window blocks in pop_wait, so pipelining is inert here
                 deadline = time.monotonic() + self.window_s
                 while len(batch) < self.max_batch:
                     remaining = deadline - time.monotonic()
@@ -897,7 +940,31 @@ class CrossModelBatcher:
                     if nxt is None:
                         break
                     batch.append(nxt)
-            self._run(batch)
+            if not self._pipeline or self.window_s > 0:
+                self._run(batch)
+                continue
+            # dispatch the NEW batch first (async stage + device call),
+            # then drain the previous call — its blocking D2H and fan-out
+            # overlap the new call's H2D/compute instead of preceding it
+            dispatched = self._run_async(batch)
+            if dispatched:
+                overlapped = (
+                    len(dispatched) if pending is not None
+                    else len(dispatched) - 1
+                )
+                if overlapped > 0:
+                    self.stats["pipeline_overlaps"] += overlapped
+                    metric_catalog.DEVICE_PIPELINE_OVERLAPS.inc(overlapped)
+            if pending is not None:
+                self._drain_call(pending)
+            # several groups in one batch were dispatched back-to-back:
+            # drain all but the last now, keep the last in flight
+            for extra in dispatched[:-1]:
+                self._drain_call(extra)
+            pending = dispatched[-1] if dispatched else None
+            # re-arm the device watchdog for whatever is still in flight
+            # (drains clear nothing themselves — the loop owns the signal)
+            self._busy_since = pending[3] if pending is not None else None
 
     def _run(self, batch: List[_Item]):
         groups: Dict[Tuple, List[_Item]] = {}
@@ -944,6 +1011,175 @@ class CrossModelBatcher:
             self._execute(spec, items[:mid])
             self._execute(spec, items[mid:])
 
+    # -------------------------------------------- pipelined device path
+    def _run_async(self, batch: List[_Item]) -> List[Tuple]:
+        """Group a batch and dispatch each group WITHOUT draining: the
+        stage + async device call of _device_call, with the blocking D2H
+        and fan-out deferred to _drain_call (the pipelined loop drains a
+        call only after dispatching its successor). A group that fails at
+        dispatch — nothing computed yet — falls back to the strict-serial
+        recovery ladder alone."""
+        groups: Dict[Tuple, List[_Item]] = {}
+        for item in batch:
+            key = (item.spec, item.X_pad.shape)
+            groups.setdefault(key, []).append(item)
+        pendings: List[Tuple] = []
+        for (spec, _shape), items in groups.items():
+            now = time.monotonic()
+            for item in items:
+                metric_catalog.BATCHER_QUEUE_WAIT_SECONDS.observe(
+                    max(0.0, now - item.t_submit)
+                )
+            metric_catalog.BATCHER_FUSE_WIDTH.observe(len(items))
+            try:
+                pending = self._device_dispatch(spec, items)
+            except BaseException as exc:  # noqa: BLE001 — ladder fallback
+                logger.warning(
+                    "pipelined dispatch over %d predicts failed (%s: %s); "
+                    "re-running strict-serial",
+                    len(items), type(exc).__name__, exc,
+                )
+                try:
+                    self._execute(spec, items)
+                except BaseException as exc2:  # noqa: BLE001 — fan out
+                    for item in items:
+                        item.error = exc2
+                        item.done.set()
+                continue
+            if pending is not None:
+                pendings.append(pending)
+        return pendings
+
+    def _device_dispatch(self, spec, items: List[_Item]) -> Optional[Tuple]:
+        """Stage + dispatch phase of the pipelined device path: resolve
+        bank slots, fill the alternating staging buffer, ship the stacked
+        input with an explicit (async) jax.device_put and issue the fused
+        call. jax dispatches asynchronously, so this returns while the
+        device is still computing — the blocking D2H lives in _drain_call.
+        Returns (spec, items, out_dev, t0, n), or None when every rider
+        was abandoned."""
+        from gordo_tpu.util import faults
+
+        import jax
+
+        items = [it for it in items if not it.abandoned]
+        if not items:
+            return None
+        n = len(items)
+        b_pad = 1
+        while b_pad < min(n, self.max_batch):
+            b_pad <<= 2
+        b_pad = min(b_pad, self.max_batch)
+        bank = self._banks.setdefault(spec, _ParamBank())
+        if len({id(it.params) for it in items}) > bank.max_models:
+            raise RuntimeError(
+                f"fused group of {len(items)} spans more distinct models "
+                f"than the param bank holds ({bank.max_models}); bisecting"
+            )
+        gen = bank.generation
+        slots = [bank.slot_of(it.params) for it in items]
+        if bank.generation != gen:
+            # same churn guard as _device_call: re-resolve once, then fail
+            # the group into the recovery ladder
+            gen = bank.generation
+            slots = [bank.slot_of(it.params) for it in items]
+            if bank.generation != gen:
+                raise RuntimeError(
+                    "param bank churned twice during slot resolution; "
+                    "retrying through the recovery ladder"
+                )
+        X, idx = self._stacked_inputs(items, slots, b_pad)
+        t0 = time.monotonic()
+        if self._busy_since is None:
+            self._busy_since = t0
+        try:
+            faults.fault_point(
+                "serve_device_call", machines=[it.tag for it in items]
+            )
+            aot = self._aot.get((spec, items[0].n_pad, b_pad, bank.capacity))
+            if aot is not None and aot[0] == X.shape:
+                program = aot[1]
+            else:
+                program = _stacked_apply(
+                    spec, items[0].n_pad, b_pad, bank.capacity
+                )
+            # explicit H2D off the pinned staging buffer: device_put frees
+            # the staging array for the NEXT fuse as soon as the copy is
+            # enqueued, and the donated device copy feeds the program
+            X_dev = jax.device_put(X)
+            out_dev = program(bank.stacked, idx, X_dev)
+        except BaseException as exc:  # noqa: BLE001 — span then re-raise
+            self._emit_device_span(items, t0, error=exc)
+            raise
+        return (spec, items, out_dev, t0, n)
+
+    def _drain_call(self, pending: Tuple) -> None:
+        """Drain phase of the pipelined device path: block on the fused
+        call's device output (D2H), then run the same fan-out tail as the
+        strict-serial path. A compute error surfacing here re-runs the
+        whole group through the recovery ladder — the failed call's
+        results never left the device, so strict-serial re-execution is
+        the correctness fallback, not a duplicate."""
+        spec, items, out_dev, t0, n = pending
+        try:
+            out = np.asarray(out_dev)
+        except BaseException as exc:  # noqa: BLE001 — ladder fallback
+            self._emit_device_span(items, t0, error=exc)
+            self._account_busy(t0)
+            logger.warning(
+                "pipelined fused call over %d predicts failed at drain "
+                "(%s: %s); re-running strict-serial",
+                n, type(exc).__name__, exc,
+            )
+            try:
+                self._execute(spec, items)
+            except BaseException as exc2:  # noqa: BLE001 — fan out
+                for item in items:
+                    item.error = exc2
+                    item.done.set()
+            return
+        self._account_busy(t0)
+        self._emit_device_span(items, t0)
+        metric_catalog.DEVICE_FLOPS.inc(
+            _spec_forward_flops(spec) * float(items[0].n_pad) * n
+        )
+        self.stats["items"] += n
+        self.stats["device_calls"] += 1
+        self.stats["largest_batch"] = max(self.stats["largest_batch"], n)
+        self._fan_out(items, out)
+
+    def _account_busy(self, t0: float) -> None:
+        """Busy-seconds for a drained pipelined call, unioned against the
+        previous drain's window: overlapping calls must not double-count
+        wall-clock, or the duty-cycle gauge would read above 1.0."""
+        end = time.monotonic()
+        start = max(t0, self._last_drain_end)
+        if end > start:
+            metric_catalog.DEVICE_BUSY_SECONDS.inc(end - start)
+        self._last_drain_end = end
+
+    def _fan_out(self, items: List[_Item], out: np.ndarray) -> None:
+        """Per-rider result fan-out shared by the strict-serial and
+        pipelined drains: slice each rider's lane, per-lane finite guard,
+        wake the waiter."""
+        from gordo_tpu.server import resilience
+        from gordo_tpu.util import faults
+
+        validate = resilience.validate_output_enabled()
+        for i, item in enumerate(items):
+            result = out[i, : item.n_keep]
+            if validate and not np.all(np.isfinite(result)):
+                # per-lane guard: vmap lanes are independent, so a
+                # poisoned submission fails alone while its cohort's
+                # results fan out untouched
+                item.error = faults.NonFiniteDataError(
+                    f"non-finite fused-predict output for model "
+                    f"{item.tag or '?'!r}"
+                )
+            else:
+                item.result = result
+            item.done.set()
+
     def _stacked_inputs(
         self, items: List[_Item], slots: List[int], b_pad: int
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -987,7 +1223,6 @@ class CrossModelBatcher:
         return X, idx
 
     def _device_call(self, spec, items: List[_Item]):
-        from gordo_tpu.server import resilience
         from gordo_tpu.util import faults
 
         # a waiter that timed out while these queued is gone: computing
@@ -1061,9 +1296,12 @@ class CrossModelBatcher:
             self._busy_since = None
             # duty-cycle accounting: busy-seconds accumulate whether the
             # call succeeded or not — the device was occupied either way
+            # (unioned against any pipelined drain sharing this window)
+            end = time.monotonic()
             metric_catalog.DEVICE_BUSY_SECONDS.inc(
-                max(0.0, time.monotonic() - t0)
+                max(0.0, end - max(t0, self._last_drain_end))
             )
+            self._last_drain_end = end
         # recorded BEFORE fan-out (done.set): a rider resuming at its
         # event must already find the device-call span in its trace
         self._emit_device_span(items, t0)
@@ -1075,20 +1313,7 @@ class CrossModelBatcher:
         self.stats["items"] += n
         self.stats["device_calls"] += 1
         self.stats["largest_batch"] = max(self.stats["largest_batch"], n)
-        validate = resilience.validate_output_enabled()
-        for i, item in enumerate(items):
-            result = out[i, : item.n_keep]
-            if validate and not np.all(np.isfinite(result)):
-                # per-lane guard: vmap lanes are independent, so a
-                # poisoned submission fails alone while its cohort's
-                # results fan out untouched
-                item.error = faults.NonFiniteDataError(
-                    f"non-finite fused-predict output for model "
-                    f"{item.tag or '?'!r}"
-                )
-            else:
-                item.result = result
-            item.done.set()
+        self._fan_out(items, out)
 
     def _emit_device_span(
         self,

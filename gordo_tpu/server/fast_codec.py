@@ -168,23 +168,38 @@ def decode_dataframe(data) -> Optional[pd.DataFrame]:
 
 
 def decode_body_xy(body):
-    """One native pass over a raw request body of exactly the shape
-    ``{"X": [[...]]}`` / ``{"X": ..., "y": ...}`` straight into float64
-    DataFrames — no ``json.loads``, no intermediate lists. Returns
-    ``(X, y_or_None)`` or ``None`` when the body doesn't match the strict
-    grammar (the caller then goes through ``loads`` + ``decode_dataframe``,
-    which is always parity-safe). The frames are exactly what
-    ``decode_dataframe`` yields for list-of-lists payloads: RangeIndex
-    rows and columns."""
+    """One native pass over a raw request body straight into float64
+    DataFrames — no ``json.loads``, no intermediate lists. Two canonical
+    grammars: the rect shape ``{"X": [[...]]}`` / ``{"X": ..., "y": ...}``
+    (RangeIndex frames, exactly what ``decode_dataframe`` yields for
+    list-of-lists payloads) and the flat column-dict shape
+    ``{"X": {name: {key: num}}}`` (the frame ``decode_dataframe`` yields
+    for dict payloads: parsed index, payload column order, sorted when
+    non-monotonic). Returns ``(X, y_or_None)`` or ``None`` when the body
+    matches neither strict grammar — the caller then goes through
+    ``loads`` + ``decode_dataframe``, which is always parity-safe."""
     if not isinstance(body, (bytes, bytearray, memoryview)):
         return None
-    parsed = native.parse_xy(body if isinstance(body, bytes) else bytes(body))
-    if parsed is None:
+    if not isinstance(body, bytes):
+        body = bytes(body)
+    parsed = native.parse_xy(body)
+    if parsed is not None:
+        X_arr, y_arr = parsed
+        X = pd.DataFrame(X_arr)
+        y = pd.DataFrame(y_arr) if y_arr is not None else None
+        return X, y
+    cols = native.parse_columns(body)
+    if cols is None:
         return None
-    X_arr, y_arr = parsed
-    X = pd.DataFrame(X_arr)
-    y = pd.DataFrame(y_arr) if y_arr is not None else None
-    return X, y
+    arr, names, keys = cols
+    index = _parse_index(keys)
+    if index is None:
+        # decode_dataframe would bail to the pandas path here too
+        return None
+    X = pd.DataFrame(arr, index=index, columns=names, copy=False)
+    if not X.index.is_monotonic_increasing:
+        X.sort_index(inplace=True)
+    return X, None
 
 
 # ------------------------------------------------------------------- encode
@@ -323,12 +338,7 @@ def encode_raw(raw) -> Optional[str]:
         keys = _index_keys(index)
         if keys is None:
             return None
-        if (
-            not _native_poisoned
-            and isinstance(index, pd.RangeIndex)
-            and index.start == 0
-            and index.step == 1
-        ):
+        if not _native_poisoned:
             fragment = _encode_raw_native(raw, index, keys)
             if fragment is not None:
                 return fragment
@@ -390,24 +400,35 @@ _native_checked: set = set()
 _native_poisoned = False
 
 
-@functools.lru_cache(maxsize=32)
-def _native_template(sig: tuple, n: int):
-    """(template bytes, per-value chunk lengths) for a RangeIndex(n)
-    response with group structure ``sig = ((top, (sub, ...)), ...)``."""
-    keys = _range_keys(n)
-    null_obj = "{" + ", ".join(f'"{k}": null' for k in keys) + "}"
+def _build_template(sig: tuple, keys: tuple, start, end):
+    """(template bytes, per-value chunk lengths) for group structure
+    ``sig = ((top, (sub, ...)), ...)`` over pre-stringified row ``keys``.
+    ``start``/``end`` are the timestamp-column value lists (``None`` =
+    all-null, the RangeIndex case) — they are static per request, so they
+    live in the template; only the float values go through the C
+    formatter."""
+    esc_keys = [_escape(k) for k in keys]
+
+    def _obj(col) -> str:
+        if col is None:
+            return "{" + ", ".join(f"{ek}: null" for ek in esc_keys) + "}"
+        return "{" + ", ".join(
+            f"{ek}: " + ("null" if v is None else _escape(v))
+            for ek, v in zip(esc_keys, col)
+        ) + "}"
+
     chunks: list = []  # static text; chunks[i] precedes value i
-    cur = [f'{{"start": {{"": {null_obj}}}, "end": {{"": {null_obj}}}']
+    cur = [f'{{"start": {{"": {_obj(start)}}}, "end": {{"": {_obj(end)}}}']
     for top, subs in sig:
         cur.append(f", {_escape(top)}: {{")
         for j, sub in enumerate(subs):
             if j:
                 cur.append(", ")
             cur.append(f"{_escape(sub)}: {{")
-            for i, key in enumerate(keys):
+            for i, ek in enumerate(esc_keys):
                 if i:
                     cur.append(", ")
-                cur.append(f"{_escape(key)}: ")
+                cur.append(f"{ek}: ")
                 chunks.append("".join(cur))
                 cur = []
             cur.append("}")
@@ -418,6 +439,15 @@ def _native_template(sig: tuple, n: int):
     template = b"".join(byte_chunks)
     pre_lens = np.array([len(c) for c in byte_chunks], dtype=np.int32)
     return template, pre_lens
+
+
+@functools.lru_cache(maxsize=32)
+def _native_template(sig: tuple, n: int):
+    """Cached ``_build_template`` for a RangeIndex(n) response — every
+    response of this (structure, n_rows) shares one template. Keyed
+    indexes (timestamps) change per request, so those templates are built
+    per call in :func:`_encode_raw_native` instead."""
+    return _build_template(sig, _range_keys(n), None, None)
 
 
 def _encode_raw_native(raw, index: pd.Index, keys) -> Optional[str]:
@@ -445,8 +475,28 @@ def _encode_raw_native(raw, index: pd.Index, keys) -> Optional[str]:
     tops = [item[0] for item in sig_items]
     if len(set(tops)) != len(tops):
         return None  # duplicate groups merge in the dict path; template can't
+    if "start" in tops or "end" in tops:
+        return None  # would merge into the timestamp columns' dicts
     sig = tuple(sig_items)
-    template, pre_lens = _native_template(sig, len(index))
+    if (
+        isinstance(index, pd.RangeIndex)
+        and index.start == 0
+        and index.step == 1
+    ):
+        template, pre_lens = _native_template(sig, len(index))
+    else:
+        # keyed (timestamp) index: keys and start/end values change per
+        # request, so the template is built per call — still a win, the
+        # n_rows of template text amortize over n_cols of C-formatted
+        # float columns
+        start, end = timestamp_columns(index, raw.frequency)
+        try:
+            str_keys = tuple(
+                k if type(k) is str else str(k) for k in keys
+            )
+            template, pre_lens = _build_template(sig, str_keys, start, end)
+        except TypeError:
+            return None  # non-str-coercible template text: dict path
     # column-major per group: group -> column -> rows, matching the
     # template's key nesting order
     vals = np.concatenate(

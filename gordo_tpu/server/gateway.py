@@ -118,6 +118,24 @@ def hedge_budget_ms() -> float:
     return _env_float("GORDO_TPU_GATEWAY_HEDGE_MS", 50.0)
 
 
+class _UDSHTTPConnection(http.client.HTTPConnection):
+    """``http.client`` over a node's advertised Unix-domain socket
+    (membership lease ``uds`` field). The host:port pair is kept for Host
+    headers and diagnostics only; ``connect()`` dials the path. Same
+    keep-alive pooling semantics as the TCP connection it replaces."""
+
+    def __init__(self, path: str, host: str, port: int, timeout=None):
+        super().__init__(host, port, timeout=timeout)
+        self.uds_path = path
+
+    def connect(self):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if self.timeout is not None:
+            sock.settimeout(self.timeout)
+        sock.connect(self.uds_path)
+        self.sock = sock
+
+
 def _ring_hash(key: str) -> int:
     return int.from_bytes(
         hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "big"
@@ -282,9 +300,12 @@ class GatewayServer(EventLoopServer):
                  request_timeout: float = 120.0):
         # the gateway has no WSGI app — every route is either proxied or
         # answered locally in _route; app=None makes any accidental
-        # fallback a loud failure instead of a silent wrong answer
+        # fallback a loud failure instead of a silent wrong answer.
+        # uds="" keeps the gateway off GORDO_TPU_UDS_PATH: that knob names
+        # a serving NODE's lane (which this gateway prefers upstream), and
+        # a co-resident gateway must not steal the node's socket path
         super().__init__(None, host=host, port=port, fd=fd,
-                         request_timeout=request_timeout)
+                         request_timeout=request_timeout, uds="")
         self.directory = directory
         self.view = membership.MembershipView(directory)
         self.ring = HashRing()
@@ -357,7 +378,7 @@ class GatewayServer(EventLoopServer):
                     break
                 for key, mask in events:
                     if key.data is None:
-                        self._accept()
+                        self._accept(key.fileobj)
                         continue
                     if key.data is _WAKE:
                         self._drain_wake()
@@ -448,7 +469,7 @@ class GatewayServer(EventLoopServer):
             while cq.next_emit in cq.ready:
                 body, close_flag = cq.ready.pop(cq.next_emit)
                 cq.next_emit += 1
-                conn.out += body
+                conn.queue(body)
                 if close_flag:
                     conn.close_after_flush = True
                 progressed = True
@@ -692,16 +713,29 @@ class GatewayServer(EventLoopServer):
     # --------------------------------------------------------- upstream I/O
     _pool = threading.local()
 
-    def _upstream_conn(self, node: membership.NodeInfo) -> http.client.HTTPConnection:
+    def _upstream_conn(
+        self, node: membership.NodeInfo, force_tcp: bool = False
+    ) -> http.client.HTTPConnection:
+        """A pooled keep-alive connection to ``node``, preferring the
+        node's advertised Unix-domain lane when its socket path exists on
+        this host (the co-located case the lane exists for); ``force_tcp``
+        pins the retry after a UDS-level failure back onto TCP."""
         pool = getattr(self._pool, "conns", None)
         if pool is None:
             pool = self._pool.conns = {}
         key = (node.node_id, node.address)
         conn = pool.get(key)
         if conn is None:
-            conn = http.client.HTTPConnection(
-                node.host, node.port, timeout=self.connect_timeout_s
-            )
+            uds = None if force_tcp else node.uds
+            if uds and os.path.exists(uds):
+                conn = _UDSHTTPConnection(
+                    uds, node.host, node.port,
+                    timeout=self.connect_timeout_s,
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    node.host, node.port, timeout=self.connect_timeout_s
+                )
             pool[key] = conn
         return conn
 
@@ -734,6 +768,7 @@ class GatewayServer(EventLoopServer):
         fwd["connection"] = "keep-alive"
         conn = self._upstream_conn(node)
         was_pooled = conn.sock is not None
+        tried_tcp = False
         while True:
             try:
                 if conn.sock is None:
@@ -752,6 +787,13 @@ class GatewayServer(EventLoopServer):
                     # retry against the SAME node before the hedge fires
                     was_pooled = False
                     conn = self._upstream_conn(node)
+                    continue
+                if isinstance(conn, _UDSHTTPConnection) and not tried_tcp:
+                    # a broken Unix-domain lane (stale advertised path,
+                    # perms) is not a node failure either: fall back to the
+                    # node's TCP address before spending a hedge
+                    tried_tcp = True
+                    conn = self._upstream_conn(node, force_tcp=True)
                     continue
                 raise
         if resp.will_close:

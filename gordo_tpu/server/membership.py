@@ -106,6 +106,10 @@ class NodeInfo:
     generation: int
     age_s: float  # seconds since the last heartbeat touched the file
     alive: bool
+    # Unix-domain socket path the node also listens on (GORDO_TPU_UDS_PATH),
+    # for co-located callers; None when the node is TCP-only or the lease
+    # predates the UDS lane
+    uds: Optional[str] = None
 
     @property
     def host(self) -> str:
@@ -133,9 +137,11 @@ class NodeRegistration:
         address: str,
         node_id: Optional[str] = None,
         on_dead: Optional[Callable[[], None]] = None,
+        uds: Optional[str] = None,
     ):
         self.directory = directory
         self.address = address
+        self.uds = uds
         self.node_id = node_id or default_node_id()
         self.on_dead = on_dead
         self._stop = threading.Event()
@@ -161,14 +167,17 @@ class NodeRegistration:
         )
 
     def _payload(self) -> str:
-        return json.dumps(
-            {
-                "node_id": self.node_id,
-                "address": self.address,
-                "pid": os.getpid(),
-                "ts": time.time(),
-            }
-        )
+        payload = {
+            "node_id": self.node_id,
+            "address": self.address,
+            "pid": os.getpid(),
+            "ts": time.time(),
+        }
+        if self.uds:
+            # co-located callers (the gateway on this host) may prefer the
+            # node's Unix-domain lane over loopback TCP
+            payload["uds"] = self.uds
+        return json.dumps(payload)
 
     def _acquire(self) -> int:
         generation = self._highest_generation() + 1
@@ -329,6 +338,7 @@ class MembershipView:
                 generation=generation,
                 age_s=max(0.0, age),
                 alive=age <= timeout,
+                uds=payload.get("uds") or None,
             )
         return nodes
 

@@ -728,6 +728,16 @@ def run_server(
         )
 
     workers = max(1, workers)
+    if workers > 1 and os.environ.get("GORDO_TPU_UDS_PATH"):
+        # forked workers would fight over one socket path (each bind
+        # unlinks its predecessor's), so the Unix-domain lane is a
+        # single-worker feature; the TCP listener is SO_REUSEADDR-shared
+        # and unaffected
+        logger.warning(
+            "GORDO_TPU_UDS_PATH ignored with %d workers (a prefork pool "
+            "cannot share one socket path)", workers,
+        )
+        os.environ.pop("GORDO_TPU_UDS_PATH", None)
     # multi-worker pools get a telemetry shard dir by default: without it
     # a /metrics or /debug/vars scrape answered by one worker would show
     # that worker's numbers only (observability/shared.py). Honour an
@@ -825,8 +835,17 @@ def run_server(
                 socket.gethostname() if host in ("0.0.0.0", "::") else host
             )
             advertise = f"{bind_host}:{listen_sock.getsockname()[1]}"
+        # advertise the Unix-domain lane (GORDO_TPU_UDS_PATH) alongside the
+        # TCP address so a co-located gateway can prefer it; the fast lane
+        # binds the path when it mounts, and the gateway falls back to TCP
+        # if the socket never appears
+        from gordo_tpu.server import fastlane
+
+        uds = fastlane.uds_path() if fastlane.enabled() else None
         try:
-            return membership.NodeRegistration(directory, address=advertise)
+            return membership.NodeRegistration(
+                directory, address=advertise, uds=uds
+            )
         except OSError:
             logger.exception(
                 "gateway registration failed; serving without membership"

@@ -77,6 +77,15 @@ METRICS: Tuple[Tuple[str, bool], ...] = (
     # an unchanged p99
     ("server_load_fastlane_p50_ms", False),
     ("server_load_fastlane_p999_ms", False),
+    # sub-millisecond hot path, phase 3 (ISSUE 19): the Unix-domain lane
+    # gates like the TCP fast lane (absent in pre-v7 records, so it only
+    # gates once both sides carry it), and kernel round-trips per request
+    # gate lower-is-better — recv coalescing and writev flushes must not
+    # quietly regress back to one syscall per read/write
+    ("server_load_uds_req_per_sec", True),
+    ("server_load_uds_p50_ms", False),
+    ("server_load_uds_p99_ms", False),
+    ("server_load_syscalls_per_req", False),
     # cross-node serving gateway arm (ISSUE 12): routed throughput and
     # tail gate like the direct arms; the p50 overhead over the direct
     # fast-lane arm and the kill-a-node recovery time gate as

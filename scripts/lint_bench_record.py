@@ -81,6 +81,12 @@ _NUMERIC_KEYS = (
     # availability, flash-crowd p99, kill-to-recovery seconds, error burn
     "abuse_availability", "abuse_flash_p99_ms", "abuse_failover_s",
     "abuse_error_burn",
+    # the hot-path keys of schema v7 (ISSUE 19): kernel round-trips per
+    # fast-lane request, device-pipeline overlap count, and the
+    # Unix-domain lane's percentiles over the same open-loop schedule
+    "server_load_syscalls_per_req", "server_load_pipeline_overlaps",
+    "server_load_uds_req_per_sec", "server_load_uds_p50_ms",
+    "server_load_uds_p99_ms",
 )
 
 
@@ -95,6 +101,9 @@ _FALLBACK_NAMES_BY_VERSION = {
     5: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build", "drift_loop", "cold_start"],
     6: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
+        "fleet_build", "drift_loop", "cold_start", "abuse"],
+    # v7 keeps v6's section list; it only adds flat summary keys
+    7: ["tpu_smoke", "serving_load", "headline", "windowed", "batch_ab",
         "fleet_build", "drift_loop", "cold_start", "abuse"],
 }
 _FALLBACK_STATUSES = [

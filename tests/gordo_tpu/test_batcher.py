@@ -687,3 +687,97 @@ def test_warmup_aot_prelowers_zero_steady_state_trace_compiles(
     assert (
         metric_catalog.TRACE_COMPILES.value() == compiles_after_warmup
     ), "post-warmup traffic paid a trace+compile in the serving path"
+
+
+# ---------------------------------------------------------------------------
+# Device-path pipelining (ISSUE 19): overlapped dispatch/drain must be
+# byte-identical to the strict-serial path, and the loop must count overlaps.
+# ---------------------------------------------------------------------------
+
+
+def _make_item(model, X):
+    from gordo_tpu.ops.train import pad_for_predict
+
+    X_pad, n_pad, n_keep = pad_for_predict(model.spec_, X)
+    item = batcher_mod._Item(
+        model.spec_, model.params_, X_pad, n_pad, n_keep,
+        done=threading.Event(),
+    )
+    item.t_submit = time.monotonic()
+    return item
+
+
+def test_pipeline_on_off_byte_parity(models, monkeypatch):
+    """The same sequential workload through a pipelined and a
+    strict-serial batcher produces byte-identical results (same program,
+    same padding — only the host/device overlap differs)."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(30, 4).astype(np.float32)
+
+    monkeypatch.setenv("GORDO_TPU_DEVICE_PIPELINE", "0")
+    serial = CrossModelBatcher(window_ms=0, max_batch=8)
+    assert serial._pipeline is False
+    got_serial = [serial.submit(m.spec_, m.params_, X) for m in models]
+
+    monkeypatch.setenv("GORDO_TPU_DEVICE_PIPELINE", "1")
+    piped = CrossModelBatcher(window_ms=0, max_batch=8)
+    assert piped._pipeline is True
+    got_piped = [piped.submit(m.spec_, m.params_, X) for m in models]
+
+    for a, b in zip(got_serial, got_piped):
+        np.testing.assert_array_equal(a, b)
+    for got, m in zip(got_piped, models):
+        np.testing.assert_allclose(got, m.predict(X), rtol=1e-6, atol=1e-7)
+    assert piped.stats["items"] == len(models)
+
+
+def test_two_outstanding_dispatches_drain_correctly(models):
+    """White-box: two fused calls in flight at once (the double-buffered
+    staging pair) drain to the same results the direct path computes —
+    the second dispatch's buffer fill must not corrupt the first call."""
+    b = CrossModelBatcher(window_ms=0, max_batch=8)
+    rng = np.random.RandomState(8)
+    X1 = rng.rand(25, 4).astype(np.float32)
+    X2 = rng.rand(25, 4).astype(np.float32)
+    i1 = _make_item(models[0], X1)
+    i2 = _make_item(models[1], X2)
+
+    p1 = b._run_async([i1])
+    p2 = b._run_async([i2])  # dispatched while p1 is still undrained
+    assert len(p1) == 1 and len(p2) == 1
+    b._drain_call(p1[0])
+    b._drain_call(p2[0])
+
+    assert i1.error is None and i2.error is None
+    np.testing.assert_allclose(
+        i1.result, models[0].predict(X1), rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        i2.result, models[1].predict(X2), rtol=1e-6, atol=1e-7
+    )
+    assert b.stats["device_calls"] == 2
+
+
+def test_pipeline_overlap_counter_counts_backed_up_ring(models):
+    """Pre-load the ring before the dispatcher thread exists, then start
+    it with max_batch=1: every call after the first is dispatched while
+    its predecessor is still in flight — overlaps == n_items - 1."""
+    b = CrossModelBatcher(window_ms=0, max_batch=1)
+    assert b._pipeline is True
+    rng = np.random.RandomState(9)
+    X = rng.rand(10, 4).astype(np.float32)
+    items = [_make_item(models[i % len(models)], X) for i in range(4)]
+    for item in items:
+        b._ring.put(item)
+    b._ensure_thread()
+    for item in items:
+        assert item.done.wait(timeout=60), "pipelined loop never fanned out"
+        assert item.error is None
+    assert b.stats["pipeline_overlaps"] == len(items) - 1
+    assert b.stats["device_calls"] == len(items)
+    for i, item in enumerate(items):
+        np.testing.assert_allclose(
+            item.result,
+            models[i % len(models)].predict(X),
+            rtol=1e-6, atol=1e-7,
+        )

@@ -433,3 +433,191 @@ def test_json_serializer_default_raises_loudly():
         simplejson.dumps(
             {"bad": Opaque()}, ignore_nan=True, default=json_serializer_default
         )
+
+
+# ------------------------------------------------- full-native codec (ISSUE 19)
+def _require_native():
+    from gordo_tpu import native
+
+    if not native.prebuild(block=True):
+        pytest.skip("native library unavailable (no g++ in this image)")
+
+
+def test_decode_body_coldict_native_parity():
+    """The flat column-dict body parses natively into the exact frame the
+    decode_dataframe dict branch yields — values, index, column order."""
+    _require_native()
+    idx = pd.date_range("2020-01-01", periods=8, freq="10min", tz="UTC")
+    df = pd.DataFrame(
+        np.random.RandomState(21).rand(8, 3), columns=["a", "b", "c"], index=idx
+    )
+    body = json.dumps({"X": dataframe_to_dict(df)}).encode()
+    parsed = fast_codec.decode_body_xy(body)
+    assert parsed is not None, "native coldict parse fell back"
+    X, y = parsed
+    assert y is None
+    ref = fast_codec.decode_dataframe(json.loads(body)["X"])
+    pd.testing.assert_frame_equal(X, ref)
+
+
+def test_decode_body_coldict_null_cells_and_unsorted_keys():
+    _require_native()
+    body = (
+        b'{"X": {"a": {"2": 3.0, "0": null, "1": 2.0},'
+        b' "b": {"2": 30.0, "0": 10.0, "1": null}}}'
+    )
+    parsed = fast_codec.decode_body_xy(body)
+    assert parsed is not None
+    X, _ = parsed
+    ref = fast_codec.decode_dataframe(json.loads(body)["X"])
+    pd.testing.assert_frame_equal(X, ref)
+    assert list(X.index) == [0, 1, 2]  # sorted like pandas
+
+
+def test_decode_body_coldict_fallback_shapes():
+    """Bodies the strict C grammar cannot prove equivalent to json.loads
+    must fall back (None), never mis-parse."""
+    _require_native()
+    bails = [
+        # ragged columns
+        b'{"X": {"a": {"0": 1.0}, "b": {"0": 1.0, "1": 2.0}}}',
+        # reordered keys across columns
+        b'{"X": {"a": {"0": 1.0, "1": 2.0}, "b": {"1": 2.0, "0": 1.0}}}',
+        # duplicate column name (json.loads collapses, last wins)
+        b'{"X": {"a": {"0": 1.0}, "a": {"0": 2.0}}}',
+        # duplicate index key within a column
+        b'{"X": {"a": {"0": 1.0, "0": 2.0}}}',
+        # escaped key spelling (same string, different bytes)
+        b'{"X": {"\\u0061": {"0": 1.0}}}',
+        # y as a column dict takes the Python path
+        b'{"X": {"a": {"0": 1.0}}, "y": {"a": {"0": 1.0}}}',
+        # non-numeric cell
+        b'{"X": {"a": {"0": "oops"}}}',
+        # multi-level payload
+        b'{"X": {"top": {"sub": {"0": 1.0}}}}',
+        # trailing garbage
+        b'{"X": {"a": {"0": 1.0}}} x',
+    ]
+    for body in bails:
+        assert fast_codec.decode_body_xy(body) is None, body
+
+
+def test_encode_raw_keyed_template_runs_native(monkeypatch):
+    """A DatetimeIndex response renders through the native template
+    encoder (per-request template, C float formatting), byte-identical to
+    the pandas path."""
+    _require_native()
+    from gordo_tpu import native
+
+    monkeypatch.setattr(fast_codec, "_native_poisoned", False)
+    calls = []
+    real = native.encode_template
+
+    def counting(*args):
+        calls.append(1)
+        return real(*args)
+
+    monkeypatch.setattr(native, "encode_template", counting)
+    idx = pd.date_range("2021-03-01", periods=9, freq="10min", tz="UTC")
+    raw = _raw_frame(idx, with_nan=True)
+    fragment = fast_codec.encode_raw(raw)
+    assert calls, "keyed index bypassed the native template encoder"
+    assert fragment == _slow_json(raw.to_pandas())
+
+
+# ------------------------------------------- native degradation matrix (ISSUE 19)
+def _golden_codec_bytes():
+    """Reference bytes for one golden decode + one golden encode, computed
+    through the pandas oracle (native-independent)."""
+    idx = pd.date_range("2020-01-01", periods=6, freq="10min", tz="UTC")
+    df = pd.DataFrame(
+        np.random.RandomState(31).rand(6, 3), columns=["a", "b", "c"], index=idx
+    )
+    body = json.dumps({"X": dataframe_to_dict(df)}).encode()
+    raw = _raw_frame(pd.RangeIndex(6), with_nan=True)
+    return body, dataframe_from_dict(json.loads(body)["X"]), raw, _slow_json(
+        raw.to_pandas()
+    )
+
+
+def _assert_degraded_parity():
+    """With the native library unavailable (whatever the reason), both
+    codec directions still produce byte/valu-identical results via the
+    numpy/python lanes, and decode_body_xy falls back instead of erring."""
+    body, ref_frame, raw, ref_fragment = _golden_codec_bytes()
+    assert fast_codec.decode_body_xy(body) is None
+    frame = fast_codec.decode_dataframe(json.loads(body)["X"])
+    assert frame is not None
+    np.testing.assert_array_equal(frame.to_numpy(), ref_frame.to_numpy())
+    assert list(map(str, frame.index)) == list(map(str, ref_frame.index))
+    fragment = fast_codec.encode_raw(raw)
+    assert fragment == ref_fragment
+
+
+def test_degradation_no_native_env(monkeypatch):
+    """GORDO_TPU_NO_NATIVE=1: the kill switch byte-matches the fallback."""
+    from gordo_tpu import native
+
+    monkeypatch.setenv("GORDO_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_encode_tpl_fn", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    monkeypatch.setattr(native, "_builder_thread", None)
+    assert native.available() is False
+    _assert_degraded_parity()
+
+
+def test_degradation_missing_compiler(monkeypatch, tmp_path):
+    """No g++ (the build subprocess cannot start): the failure latches and
+    every codec path byte-matches the fallback."""
+    from gordo_tpu import native
+
+    def no_compiler(*args, **kwargs):
+        raise OSError("g++ not found")
+
+    monkeypatch.setenv("GORDO_TPU_NATIVE_CACHE", str(tmp_path))
+    monkeypatch.delenv("GORDO_TPU_NO_NATIVE", raising=False)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_encode_tpl_fn", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    monkeypatch.setattr(native, "_builder_thread", None)
+    monkeypatch.setattr(native, "_so_path_cache", None)
+    monkeypatch.setattr(native.subprocess, "run", no_compiler)
+    assert native.available() is False  # kicks the doomed background build
+    thread = native._builder_thread
+    if thread is not None:
+        thread.join(timeout=10)
+    assert native._load_failed is True
+    assert native.available() is False
+    _assert_degraded_parity()
+
+
+def test_degradation_mid_build(monkeypatch, tmp_path):
+    """available() while the compile is still in flight: False, no block,
+    and the codec byte-matches the fallback until the artifact lands."""
+    import threading
+
+    from gordo_tpu import native
+
+    release = threading.Event()
+
+    def slow_build():
+        release.wait(timeout=30)
+        return None
+
+    monkeypatch.setenv("GORDO_TPU_NATIVE_CACHE", str(tmp_path))
+    monkeypatch.delenv("GORDO_TPU_NO_NATIVE", raising=False)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_encode_tpl_fn", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    monkeypatch.setattr(native, "_builder_thread", None)
+    monkeypatch.setattr(native, "_so_path_cache", None)
+    monkeypatch.setattr(native, "_build", slow_build)
+    try:
+        assert native.available() is False  # build now in flight, no block
+        assert native._builder_thread is not None
+        assert native._builder_thread.is_alive()
+        _assert_degraded_parity()
+    finally:
+        release.set()
+        native._builder_thread.join(timeout=10)

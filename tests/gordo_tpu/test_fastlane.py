@@ -740,7 +740,7 @@ def test_sweep_flushes_buffered_response_during_drain(app):
         200, [("Content-Type", "text/plain")], body, keep_alive=False
     )
     conn = fastlane._Conn(srv_side)
-    conn.out += payload
+    conn.queue(payload)
     conn.close_after_flush = True
     conn.last_activity = time.monotonic() - 10_000  # far past every bound
     server._conns[srv_side.fileno()] = conn
@@ -872,3 +872,178 @@ def test_fast_lane_load_smoke(fast_server, gordo_project, gordo_name):
     # the per-phase histograms came through Server-Timing on the fast lane
     assert "decode" in report["phases"]
     assert "predict" in report["phases"]
+
+
+# ------------------------------------- UDS lane + syscall batching (ISSUE 19)
+def _load_test_module():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parents[2] / "benchmarks")
+    )
+    import load_test
+
+    return load_test
+
+
+@pytest.fixture()
+def uds_server(app, tmp_path):
+    path = str(tmp_path / "node.sock")
+    server = fastlane.EventLoopServer(
+        app, host="127.0.0.1", port=0, uds=path
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def _uds_request(uds_path, method, path, body=None, headers=None):
+    load_test = _load_test_module()
+    conn = load_test.UDSHTTPConnection(uds_path, timeout=60)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+    finally:
+        conn.close()
+
+
+def test_uds_lane_byte_parity_with_tcp(
+    uds_server, gordo_project, gordo_name, X_payload
+):
+    """The same POST over the TCP listener and the Unix-domain listener of
+    ONE server produces byte-identical (normalized) responses — the UDS
+    is an extra lane, not a different server."""
+    import os
+
+    assert uds_server.uds_path and os.path.exists(uds_server.uds_path)
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/anomaly/prediction"
+    rect = X_payload.values.tolist()
+    body = json.dumps({"X": rect, "y": rect}).encode()
+    headers = {"Content-Type": "application/json"}
+    tcp_status, tcp_headers, tcp_body = _fast_request(
+        uds_server, "POST", path, body=body, headers=headers
+    )
+    uds_status, uds_headers, uds_body = _uds_request(
+        uds_server.uds_path, "POST", path, body=body, headers=headers
+    )
+    assert tcp_status == uds_status == 200
+    assert _normalized(uds_body) == _normalized(tcp_body)
+    # tracing rides the UDS lane exactly like TCP
+    assert "server-timing" in uds_headers
+    assert len(uds_headers.get("x-gordo-trace", "")) == 32
+
+
+def test_uds_socket_unlinked_on_close(app, tmp_path):
+    import os
+
+    path = str(tmp_path / "closing.sock")
+    server = fastlane.EventLoopServer(
+        app, host="127.0.0.1", port=0, uds=path
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        assert os.path.exists(path)
+    finally:
+        server.server_close()
+        thread.join(timeout=5)
+    assert not os.path.exists(path)
+
+
+def test_uds_load_smoke(uds_server, gordo_project, gordo_name):
+    """The load generator's --uds transport end to end: discovery and
+    every request ride the Unix-domain lane (uds_send_factory's pooled
+    keep-alive connections), and the report says so."""
+    load_test = _load_test_module()
+    report = load_test.run(
+        host="http://uds-only",  # never dialed: every hop rides the socket
+        project=gordo_project,
+        machine=gordo_name,
+        mode="qps",
+        qps=30,
+        users=4,
+        duration=1.0,
+        warmup=0.2,
+        samples=20,
+        flight=False,
+        uds=uds_server.uds_path,
+    )
+    assert "error" not in report, report
+    assert report["transport"] == "uds"
+    assert report["requests"] > 0
+    assert report["errors"] == 0
+    assert report["p50_ms"] > 0
+
+
+def test_writev_serial_flush_byte_parity(
+    app, monkeypatch, gordo_project, gordo_name, X_payload
+):
+    """A pipelined burst flushed via vectored sendmsg (default) and via
+    the strict serial-send fallback (GORDO_TPU_FASTLANE_WRITEV=0) yields
+    an identical byte stream — the knob changes syscall count, never
+    bytes."""
+    body = json.dumps({"X": X_payload.values.tolist()}).encode()
+    req = _raw_request(gordo_project, gordo_name, body)
+
+    def burst(server):
+        sock = socket.create_connection(
+            ("127.0.0.1", server.server_port), timeout=60
+        )
+        try:
+            sock.sendall(req * 3)
+            reader = sock.makefile("rb")
+            out = []
+            for _ in range(3):
+                status, payload = _read_one_response(reader)
+                assert status == 200
+                out.append(payload)
+            return out
+        finally:
+            sock.close()
+
+    responses = {}
+    for mode, knob in (("writev", "1"), ("serial", "0")):
+        monkeypatch.setenv("GORDO_TPU_FASTLANE_WRITEV", knob)
+        server = fastlane.EventLoopServer(app, host="127.0.0.1", port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            assert server._writev is (knob == "1")
+            responses[mode] = [_normalized(b) for b in burst(server)]
+        finally:
+            server.server_close()
+            thread.join(timeout=5)
+    assert responses["writev"] == responses["serial"]
+
+
+def test_fastlane_syscall_counter_moves(
+    fast_server, gordo_project, gordo_name, X_payload
+):
+    """gordo_server_fastlane_syscalls_total counts the event-loop lane's
+    real kernel round trips — the bench's syscalls-per-request metric
+    divides its delta, so it must move under traffic."""
+    from gordo_tpu.observability import metrics as metric_catalog
+
+    if not isinstance(fast_server, fastlane.EventLoopServer):
+        pytest.skip("syscall accounting is an event-loop lane feature")
+
+    def total():
+        return sum(
+            metric_catalog.FASTLANE_SYSCALLS.value(op=op)
+            for op in ("recv", "send")
+        )
+
+    before = total()
+    path = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    body = json.dumps({"X": X_payload.values.tolist()}).encode()
+    status, _, _ = _fast_request(
+        fast_server, "POST", path, body=body,
+        headers={"Content-Type": "application/json"},
+    )
+    assert status == 200
+    assert total() > before

@@ -695,3 +695,127 @@ def test_gateway_stale_orphan_lease_never_attracts_traffic(fleet):
     assert all(r[2] != "node-ghost" for r in results)
     assert "node-ghost" not in server.ring.nodes
     assert "node-ghost" not in server._live
+
+
+# ------------------------------------------------ Unix-domain lane (ISSUE 19)
+def test_membership_uds_round_trip(tmp_path, monkeypatch):
+    """A node's advertised UDS path survives the lease write -> poll
+    round trip; nodes that advertise none read back as None."""
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.0")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.1")
+    view = membership.MembershipView(str(tmp_path))
+    sock_path = str(tmp_path / "node-a.sock")
+    with_uds = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:5555", node_id="node-a",
+        uds=sock_path,
+    )
+    without = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:5556", node_id="node-b"
+    )
+    try:
+        nodes = view.poll()
+        assert nodes["node-a"].uds == sock_path
+        assert nodes["node-b"].uds is None
+    finally:
+        with_uds.close()
+        without.close()
+
+
+def _tiny_wsgi_app(environ, start_response):
+    body = json.dumps(
+        {"node": "uds-only", "path": environ["PATH_INFO"]}
+    ).encode()
+    start_response(
+        "200 OK",
+        [("Content-Type", "application/json"),
+         ("Content-Length", str(len(body)))],
+    )
+    return [body]
+
+
+def test_gateway_routes_over_advertised_uds(tmp_path, monkeypatch):
+    """The gateway dials a co-located node's advertised Unix-domain
+    socket: the node's lease names a TCP address nothing listens on, so
+    the 200 can only have traveled the UDS lane."""
+    from gordo_tpu.server import fastlane
+
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.5")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.2")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_HEALTH_S", "5.0")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S", "0.5")
+    sock_path = str(tmp_path / "node-uds.sock")
+    node = fastlane.EventLoopServer(
+        _tiny_wsgi_app, host="127.0.0.1", port=0, uds=sock_path
+    )
+    node_thread = threading.Thread(target=node.serve_forever, daemon=True)
+    node_thread.start()
+    registration = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:1",  # dead TCP: UDS or bust
+        node_id="node-uds", uds=sock_path,
+    )
+    server = _make_gateway(tmp_path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not server.ring.nodes and time.monotonic() < deadline:
+            time.sleep(0.05)
+        status, headers, body = _gateway_request(
+            server, "GET", "/gordo/v0/proj/m-001/metadata"
+        )
+        assert status == 200, body[:300]
+        assert headers["x-gordo-gateway-node"] == "node-uds"
+        assert json.loads(body)["node"] == "uds-only"
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        registration.close()
+        node.server_close()
+        node_thread.join(timeout=5)
+
+
+def test_gateway_falls_back_to_tcp_on_stale_uds(tmp_path, monkeypatch):
+    """A stale advertised socket path (node restarted without its UDS
+    lane) is not a node failure: the gateway retries the same node over
+    its TCP address before spending a hedge."""
+    from gordo_tpu.server import fastlane
+
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.5")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.2")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_HEALTH_S", "5.0")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S", "0.5")
+    node = fastlane.EventLoopServer(
+        _tiny_wsgi_app, host="127.0.0.1", port=0, uds=""
+    )
+    node_thread = threading.Thread(target=node.serve_forever, daemon=True)
+    node_thread.start()
+    # advertise a path that EXISTS (so the gateway prefers it) but that
+    # nothing serves — a socket file with no listener behind it
+    stale = tmp_path / "stale.sock"
+    orphan = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    orphan.bind(str(stale))
+    orphan.close()  # closed without listen(): connects fail, file stays
+    registration = membership.NodeRegistration(
+        str(tmp_path), address=f"127.0.0.1:{node.server_port}",
+        node_id="node-tcp", uds=str(stale),
+    )
+    server = _make_gateway(tmp_path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not server.ring.nodes and time.monotonic() < deadline:
+            time.sleep(0.05)
+        status, headers, body = _gateway_request(
+            server, "GET", "/gordo/v0/proj/m-001/metadata"
+        )
+        assert status == 200, body[:300]
+        assert headers["x-gordo-gateway-node"] == "node-tcp"
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        registration.close()
+        node.server_close()
+        node_thread.join(timeout=5)

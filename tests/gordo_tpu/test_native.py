@@ -153,3 +153,88 @@ def test_resample_rejects_length_mismatch():
     with pytest.raises(ValueError, match="length mismatch"):
         native.resample(ts, vals, origin_ns=0, bucket_ns=600_000_000_000,
                         n_buckets=10, methods=["mean"])
+
+
+# ---------------------------------------------- builder-thread lifecycle
+def _fresh_builder_state(monkeypatch, tmp_path):
+    from gordo_tpu import native
+
+    monkeypatch.setenv("GORDO_TPU_NATIVE_CACHE", str(tmp_path))
+    monkeypatch.delenv("GORDO_TPU_NO_NATIVE", raising=False)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_encode_tpl_fn", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    monkeypatch.setattr(native, "_builder_thread", None)
+    monkeypatch.setattr(native, "_so_path_cache", str(tmp_path / "stub.so"))
+    return native
+
+
+def test_prebuild_joins_inflight_builder_without_second_compile(
+    monkeypatch, tmp_path
+):
+    """prebuild(block=True) after a non-blocking available() already
+    started the builder must join THAT build — never kick a second
+    compile of the same artifact."""
+    import threading
+    import time
+
+    native = _fresh_builder_state(monkeypatch, tmp_path)
+    builds = []
+    release = threading.Event()
+
+    def counting_build():
+        builds.append(1)
+        release.wait(timeout=30)
+        return None
+
+    monkeypatch.setattr(native, "_build", counting_build)
+    assert native.available() is False  # non-blocking: starts the builder
+    first = native._builder_thread
+    assert first is not None and first.is_alive()
+
+    results = []
+    joiner = threading.Thread(
+        target=lambda: results.append(native.prebuild(block=True))
+    )
+    joiner.start()
+    deadline = time.monotonic() + 5
+    while joiner.is_alive() and time.monotonic() < deadline and not builds:
+        time.sleep(0.01)
+    # the blocking prebuild is waiting on the ORIGINAL builder
+    assert native._builder_thread is first
+    assert len(builds) == 1
+    release.set()
+    joiner.join(timeout=10)
+    assert results == [False]  # the stubbed build produced no artifact
+    assert len(builds) == 1, "prebuild spawned a second compile"
+    assert native._load_failed is True
+
+
+def test_crashed_builder_is_restarted_but_clean_failure_latches(
+    monkeypatch, tmp_path
+):
+    """A builder that died by exception (no artifact, no latch) is
+    replaced on the next request; a clean build failure latches and is
+    never retried."""
+    native = _fresh_builder_state(monkeypatch, tmp_path)
+    builds = []
+
+    def crashing_build():
+        builds.append(1)
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(native, "_build", crashing_build)
+    assert native.available() is False
+    native._builder_thread.join(timeout=10)
+    assert native._load_failed is False  # crash leaves the latch open
+    assert len(builds) == 1
+
+    # next blocking prebuild retries with a fresh builder...
+    monkeypatch.setattr(native, "_build", lambda: builds.append(1) or None)
+    assert native.prebuild(block=True) is False
+    assert len(builds) == 2
+    assert native._load_failed is True  # ...whose clean failure latches
+
+    # latched: further prebuilds neither restart nor compile again
+    assert native.prebuild(block=True) is False
+    assert len(builds) == 2

@@ -167,6 +167,13 @@ def test_encode_slowdown_fires_sentinel_under_live_load_e2e(
         "GORDO_TPU_PERF_SENTINEL_MIN_SAMPLES", str(baseline_n)
     )
     monkeypatch.setenv("GORDO_TPU_PERF_SENTINEL_THRESHOLD", "4")
+    # Zero the re-arm cooldown: under a loaded test host, scheduler
+    # jitter can trip the detector on an honest-but-noisy sample before
+    # the wedge arms, and the default 300 s hysteresis would then keep
+    # the sentinel silent for the wedged requests. With no cooldown the
+    # detector re-arms on the next observation, so the wedge still
+    # produces its own unmistakable (>= 50 ms) event.
+    monkeypatch.setenv("GORDO_TPU_PERF_SENTINEL_COOLDOWN_S", "0")
     monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
     monkeypatch.setenv("GORDO_TPU_PROFILE_HZ", "200")
     monkeypatch.setenv(
@@ -194,6 +201,16 @@ def test_encode_slowdown_fires_sentinel_under_live_load_e2e(
     thread.start()
     body = json.dumps({"X": dataframe_to_dict(X_payload)}).encode()
     path = f"/gordo/v0/{gordo_project}/{gordo_name}/prediction"
+    def wedge_events():
+        # only the injected wedge can push the encode phase past 50 ms;
+        # jitter-induced firings stay within a few ms of baseline
+        return [
+            e for e in flight.default_recorder().events()
+            if e["kind"] == "perf_regression"
+            and e["payload"]["phase"] == "encode"
+            and e["payload"]["observed_ms"] >= 50.0
+        ]
+
     try:
         fired = False
         for _ in range(baseline_n + 40):
@@ -210,10 +227,11 @@ def test_encode_slowdown_fires_sentinel_under_live_load_e2e(
                 assert resp.status == 200
             finally:
                 conn.close()
-            if "encode" in sentinel.regressed_phases():
+            if wedge_events():
                 fired = True
                 break
-        assert fired, sentinel.snapshot()
+        assert fired, (sentinel.snapshot(),
+                       flight.default_recorder().events())
     finally:
         server.server_close()
         thread.join(timeout=5)
@@ -221,14 +239,8 @@ def test_encode_slowdown_fires_sentinel_under_live_load_e2e(
         faults.reset_plan()
         profiler.reset()
 
-    events = [
-        e for e in flight.default_recorder().events()
-        if e["kind"] == "perf_regression"
-    ]
-    encode_events = [
-        e for e in events if e["payload"]["phase"] == "encode"
-    ]
-    assert encode_events, events
+    encode_events = wedge_events()
+    assert encode_events, flight.default_recorder().events()
     payload = encode_events[0]["payload"]
     # evidence bundle: which window moved...
     assert payload["attribution"]["enabled"] is True
