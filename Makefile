@@ -69,8 +69,10 @@ lint-chaos-scenarios:
 	python scripts/lint_chaos_scenario.py
 
 # one real chaos drill against a live 3-node stack: kill a node mid-ramp,
-# assert the availability floor, failover bound and exact histogram merge
-# (see docs/robustness.md "Chaos conductor")
+# assert the availability floor, failover bound, exact histogram merge and
+# that the failover is visible as a hedge-arm span in one stitched trace
+# (see docs/robustness.md "Chaos conductor"); tier-1 runs the same drill
+# (scaled down) plus these invariants via tests/gordo_tpu/test_chaos_conductor.py
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m gordo_tpu.cli.cli chaos run \
 		resources/chaos/kill_node_mid_ramp.yaml

@@ -248,10 +248,10 @@ class WorkerStats:
         self.requests += 1
         if expected_interval_s:
             self.hist.record_with_expected_interval(
-                latency_s, expected_interval_s
+                latency_s, expected_interval_s, trace_id
             )
         else:
-            self.hist.record(latency_s)
+            self.hist.record(latency_s, trace_id)
         for name, duration in phases.items():
             hist = self.phase_hists.get(name)
             if hist is None:
@@ -914,12 +914,37 @@ def summarize(
 
 
 # ------------------------------------------------- flight-recorder check
+def _trace_spans(doc: dict, trace_id: str) -> list:
+    """The slowest-report span rows for one trace out of a flight doc —
+    gateway-stitched spans carry the node id they ran on."""
+    spans = []
+    for event in doc.get("traceEvents", []):
+        args = event.get("args") or {}
+        if args.get("trace_id") != trace_id:
+            continue
+        row = {
+            "name": event.get("name"),
+            "dur_ms": round(event.get("dur", 0.0) / 1e3, 3),
+            "span_id": args.get("span_id"),
+            "parent_span_id": args.get("parent_span_id"),
+        }
+        if args.get("gordo_node"):
+            row["node"] = args["gordo_node"]
+        spans.append(row)
+    return spans
+
+
 def fetch_worst_traces(host: str, slowest: list) -> dict:
-    """Pull ``/debug/flight`` and return the span trees of the slowest
-    requests this run produced — the load harness's closing argument:
-    not just "p99.9 was 412ms" but "and here is where those requests
-    spent it". Degrades to a reason string when the debug surface is
-    gated off (GORDO_TPU_DEBUG_ENDPOINTS unset) or unreachable."""
+    """Return the span trees of the slowest requests this run produced —
+    the load harness's closing argument: not just "p99.9 was 412ms" but
+    "and here is where those requests spent it". Each trace is fetched
+    through ``/debug/flight?trace=<id>``, so against a gateway the tree
+    comes back *stitched* — gateway spans plus the upstream node's
+    subtree, each span tagged with the node it ran on. Ids the per-trace
+    endpoint no longer holds fall back to one bulk ``/debug/flight``
+    pull (the tail-sampled rings outlive the recent ring). Degrades to a
+    reason string when the debug surface is gated off
+    (GORDO_TPU_DEBUG_ENDPOINTS unset) or unreachable."""
     wanted = {
         entry["trace_id"]: entry["latency_ms"]
         for entry in slowest
@@ -927,53 +952,69 @@ def fetch_worst_traces(host: str, slowest: list) -> dict:
     }
     if not wanted:
         return {"available": False, "reason": "no trace ids collected"}
-    try:
-        doc = _get_json(f"{host}/debug/flight")
-    except urllib.error.HTTPError as exc:
-        reason = f"HTTP {exc.code}"
-        if exc.code == 404:
-            reason += " (enable GORDO_TPU_DEBUG_ENDPOINTS=1 on the server)"
-        exc.close()
-        return {"available": False, "reason": reason}
-    except Exception as exc:  # noqa: BLE001 — the report survives a dead server
-        return {"available": False, "reason": repr(exc)[:160]}
 
-    summaries = {
-        record.get("trace_id"): record
-        for record in doc.get("gordoFlight", [])
-    }
-    spans_by_trace: dict = {}
-    for event in doc.get("traceEvents", []):
-        trace_id = (event.get("args") or {}).get("trace_id")
-        if trace_id in wanted:
-            spans_by_trace.setdefault(trace_id, []).append(
-                {
-                    "name": event.get("name"),
-                    "dur_ms": round(event.get("dur", 0.0) / 1e3, 3),
-                    "span_id": (event.get("args") or {}).get("span_id"),
-                    "parent_span_id": (event.get("args") or {}).get(
-                        "parent_span_id"
-                    ),
-                }
-            )
+    bulk: dict = {}
+
+    def bulk_doc():
+        if not bulk:
+            try:
+                bulk["doc"] = _get_json(f"{host}/debug/flight")
+            except urllib.error.HTTPError as exc:
+                reason = f"HTTP {exc.code}"
+                if exc.code == 404:
+                    reason += (
+                        " (enable GORDO_TPU_DEBUG_ENDPOINTS=1 on the server)"
+                    )
+                exc.close()
+                bulk["reason"] = reason
+            except Exception as exc:  # noqa: BLE001 — report survives a dead server
+                bulk["reason"] = repr(exc)[:160]
+        return bulk.get("doc")
+
     worst = []
     for trace_id, latency_ms in sorted(
         wanted.items(), key=lambda item: -(item[1] or 0)
     ):
-        spans = sorted(
-            spans_by_trace.get(trace_id, []), key=lambda s: -s["dur_ms"]
-        )
-        summary = summaries.get(trace_id) or {}
-        worst.append(
-            {
-                "trace_id": trace_id,
-                "latency_ms": latency_ms,
-                "recorded": trace_id in spans_by_trace,
-                "class": summary.get("class"),
-                "status": summary.get("status"),
-                "spans": spans,
+        doc = None
+        try:
+            doc = _get_json(f"{host}/debug/flight?trace={trace_id}")
+        except urllib.error.HTTPError as exc:
+            exc.close()
+        except Exception:  # noqa: BLE001
+            pass
+        stitch = None
+        if doc is not None:
+            stitch = doc.get("gordoStitch")
+        else:
+            doc = bulk_doc()
+        if doc is None:
+            # nothing fetchable at all: surface the gate/transport reason
+            return {
+                "available": False,
+                "reason": bulk.get("reason", "debug surface unreachable"),
             }
+        spans = sorted(
+            _trace_spans(doc, trace_id), key=lambda s: -s["dur_ms"]
         )
+        summary = next(
+            (r for r in doc.get("gordoFlight", [])
+             if r.get("trace_id") == trace_id),
+            {},
+        )
+        entry = {
+            "trace_id": trace_id,
+            "latency_ms": latency_ms,
+            "recorded": bool(spans),
+            "class": summary.get("class"),
+            "status": summary.get("status"),
+            "spans": spans,
+        }
+        if stitch is not None:
+            entry["stitched_nodes"] = [
+                n.get("node") for n in stitch.get("nodes", ()) if n.get("ok")
+            ]
+            entry["stitch_complete"] = bool(stitch.get("complete"))
+        worst.append(entry)
     return {
         "available": True,
         "recorded": sum(1 for w in worst if w["recorded"]),

@@ -97,7 +97,7 @@ def _gateway_send(port: int):
             resp = conn.getresponse()
             resp.read()
             if 200 <= resp.status < 300:
-                return None, resp.headers.get("X-Gordo-Gateway-Node"), {}
+                return None, resp.headers.get("X-Gordo-Trace"), {}
             return f"http-{resp.status}", None, {}
         except OSError as exc:
             return repr(exc)[:80], None, {}
@@ -171,6 +171,80 @@ def _run_drift_burst(spec: Scenario, directory: str, t0: float,
     })
 
 
+def _capture_stitched_trace(stack: ChaosStack, primaries: Dict[str, str],
+                            fired: List[dict], stop: threading.Event,
+                            out: dict) -> None:
+    """Keep one traced probe in flight around the kill: the moment a
+    kill/stop action fires, a probe against a victim-primary machine
+    rides the gateway's hedge, and its stitched
+    ``/debug/flight?trace=<id>`` document is the drill's failover
+    evidence. Probes run continuously (the kill must land close to
+    mid-request for the failed-attempt span to be real — once the
+    gateway marks the victim dead it stops trying it), each under a
+    fresh trace id, until a capture satisfies the ``stitched_trace``
+    checker or the drill ends."""
+    from gordo_tpu.chaos.invariants import CHECKERS
+    from gordo_tpu.observability import tracing
+
+    machines = sorted(primaries)
+    grace_until = None
+    attempt = 0
+    while True:
+        kill = next((a for a in fired
+                     if a["action"] in ("kill_node", "stop_node")
+                     and "node_id" in a), None)
+        victim = kill["node_id"] if kill is not None else None
+        targets = ([m for m in machines if primaries[m] == victim]
+                   if victim is not None else machines)
+        if victim is not None and not targets:
+            out["reason"] = f"no machine had {victim} as ring primary"
+            return
+        machine = targets[attempt % len(targets)]
+        attempt += 1
+        trace_id = tracing.new_trace_id()
+        traceparent = f"00-{trace_id}-{tracing.new_span_id()}-01"
+        status, _headers, _body = stack.request(
+            "GET", f"/gordo/v0/chaos/{machine}/prediction",
+            timeout=10.0, headers={"traceparent": traceparent},
+        )
+        if victim is not None and 200 <= status < 300:
+            s2, _h2, raw = stack.request(
+                "GET", f"/debug/flight?trace={trace_id}"
+            )
+            doc = None
+            if s2 == 200:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    doc = None
+            if isinstance(doc, dict):
+                candidate = {"doc": doc, "victim": victim,
+                             "trace_id": trace_id}
+                ok, detail = CHECKERS["stitched_trace"](
+                    RunContext(stitched=candidate), {}
+                )
+                if ok:
+                    out.pop("reason", None)
+                    out.update(candidate)
+                    return
+                # keep the best-failing evidence for the invariant report
+                out.update(candidate)
+                out["reason"] = detail
+        if stop.is_set():
+            if kill is None:
+                out.setdefault("reason", "no kill/stop action fired")
+                return
+            # short grace window: the load can end moments after the kill
+            if grace_until is None:
+                grace_until = time.monotonic() + 3.0
+            elif time.monotonic() > grace_until:
+                out.setdefault(
+                    "reason", "no qualifying capture before drill end"
+                )
+                return
+        stop.wait(0.04)
+
+
 def run_scenario(spec: Scenario, directory: str,
                  stack_timeout: float = 30.0) -> dict:
     """Run one parsed scenario under ``directory`` (membership dir, drift
@@ -211,6 +285,16 @@ def run_scenario(spec: Scenario, directory: str,
                 daemon=True,
             )
             timeline_thread.start()
+
+            stitched: dict = {}
+            stitch_thread = None
+            if any(inv.check == "stitched_trace" for inv in spec.invariants):
+                stitch_thread = threading.Thread(
+                    target=_capture_stitched_trace,
+                    args=(stack, primaries, fired, stop, stitched),
+                    daemon=True,
+                )
+                stitch_thread.start()
 
             chaff_results: List[dict] = []
             chaff_threads = []
@@ -260,6 +344,8 @@ def run_scenario(spec: Scenario, directory: str,
                 t.join(timeout=10.0)
             if drift_thread is not None:
                 drift_thread.join(timeout=30.0)
+            if stitch_thread is not None:
+                stitch_thread.join(timeout=15.0)
 
             breakers = {}
             for i in range(spec.nodes):
@@ -277,6 +363,7 @@ def run_scenario(spec: Scenario, directory: str,
                 actions=fired,
                 breakers=breakers,
                 drift=drift_result or None,
+                stitched=stitched or None,
             )
             results = evaluate(spec.invariants, ctx)
 
@@ -317,6 +404,11 @@ def run_scenario(spec: Scenario, directory: str,
         "actions": fired,
         "chaff": chaff_results,
         "drift": drift_result or None,
+        "stitched_trace": (
+            {k: stitched.get(k) for k in ("trace_id", "victim", "reason")
+             if stitched.get(k) is not None}
+            or None
+        ) if stitched else None,
         "invariants": results,
         "ok": all(r["ok"] for r in results),
     })

@@ -21,7 +21,11 @@ The context (:class:`RunContext`) fields the checkers read:
   offsets, ``action``, ``node``/``node_id``;
 - ``breakers`` — node_id -> {model: state int} (reachable nodes only);
 - ``drift`` — the exactly-once enqueue burst result, when the scenario
-  ran one.
+  ran one;
+- ``stitched`` — the cross-node trace capture, when the scenario declared
+  the ``stitched_trace`` invariant: ``{"doc": <the gateway's stitched
+  /debug/flight?trace= document>, "victim": <killed node id>,
+  "trace_id": ..., "reason": <why capture fell short, when it did>}``.
 """
 
 from dataclasses import dataclass, field
@@ -40,6 +44,7 @@ class RunContext:
     actions: List[dict] = field(default_factory=list)
     breakers: Dict[str, Dict[str, int]] = field(default_factory=dict)
     drift: Optional[dict] = None
+    stitched: Optional[dict] = None
 
 
 Checker = Callable[[RunContext, dict], Tuple[bool, str]]
@@ -192,6 +197,63 @@ def _one_rebuild(ctx: RunContext, params: dict) -> Tuple[bool, str]:
     return ok, (
         f"{machines} drifted machines -> queue depth {depth}, "
         f"{wins} winning enqueues (threads {drift['threads']})"
+    )
+
+
+@_checker("stitched_trace")
+def _stitched_trace(ctx: RunContext, params: dict) -> Tuple[bool, str]:
+    """The failover was *visible in one stitched trace*: the gateway's
+    ``/debug/flight?trace=<id>`` document for a request traced across the
+    kill must hold the gateway root, a failed upstream-attempt span on
+    the victim, a successful hedge-arm attempt span on a survivor, and
+    the survivor's own node-side subtree (``serve_request`` →
+    ``serve_batch_queue`` → ``serve_device_call``) grafted under that
+    hedge arm."""
+    stitched = ctx.stitched
+    if not stitched or not isinstance(stitched.get("doc"), dict):
+        reason = (stitched or {}).get("reason", "no stitched trace captured")
+        return False, str(reason)
+    doc = stitched["doc"]
+    victim = stitched.get("victim")
+    spans = [(e.get("name"), e.get("args") or {})
+             for e in doc.get("traceEvents") or []]
+    if not any(n == "gateway_request" for n, _ in spans):
+        return False, "stitched doc has no gateway_request root span"
+    attempts = [a for n, a in spans if n == "gateway_upstream_attempt"]
+    failed_on_victim = [a for a in attempts
+                        if a.get("node") == victim and a.get("error")]
+    hedge_ok = [a for a in attempts
+                if a.get("node") != victim and a.get("status") == "200"]
+    if not failed_on_victim:
+        return False, (
+            f"no failed attempt span on victim {victim} "
+            f"({len(attempts)} attempt spans)"
+        )
+    if not hedge_ok:
+        return False, "no successful hedge-arm attempt span on a survivor"
+    hedge_ids = {a.get("span_id") for a in hedge_ok}
+    roots = [a for n, a in spans
+             if n == "serve_request" and a.get("parent_span_id") in hedge_ids]
+    queue_ids = {a.get("span_id") for n, a in spans
+                 if n == "serve_batch_queue"
+                 and a.get("parent_span_id") in {r.get("span_id") for r in roots}}
+    device = [a for n, a in spans
+              if n == "serve_device_call"
+              and a.get("parent_span_id") in queue_ids]
+    if not roots:
+        return False, (
+            "survivor's serve_request subtree missing (stitch: "
+            f"{doc.get('gordoStitch')})"
+        )
+    if not device:
+        return False, "survivor subtree incomplete (no serve_device_call)"
+    survivor = hedge_ok[0].get("node")
+    return True, (
+        f"one tree: victim {victim} attempt failed "
+        f"({failed_on_victim[0].get('error', '')[:40]!r}), hedge arm on "
+        f"{survivor} succeeded with full node subtree "
+        f"({len(spans)} spans, complete="
+        f"{(doc.get('gordoStitch') or {}).get('complete')})"
     )
 
 

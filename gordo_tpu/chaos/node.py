@@ -19,12 +19,23 @@ Routes:
   drives the burn up and the drain genuinely fires);
 - ``GET /chaos/breakers`` — {model: breaker state} for the
   ``breaker_scoped`` invariant checker;
+- ``GET /debug/flight`` (``?trace=<id>``) — the node's flight recorder
+  as a Chrome-trace document, gated by ``GORDO_TPU_DEBUG_ENDPOINTS``
+  exactly like the real node's debug surface; this is the subtree the
+  gateway's cross-node stitcher fetches;
 - ``/gordo/v0/<project>/<machine>/...`` — the serving path: first hit
   per machine passes ``serve_model_load`` (wedge = artifact-load stall),
   every hit passes ``serve_predict`` then ``serve_device_call`` (wedge =
   stuck device call), all guarded by the machine's circuit breaker.
   Injected transients answer 503 + Retry-After, permanents 500 — the
   same status contract as the real views.
+
+A request carrying a ``traceparent`` header gets the real node-side
+span tree (``serve_request`` → ``serve_batch_queue`` →
+``serve_device_call``), an ``X-Gordo-Trace`` echo, and a flight-recorder
+observation — so a stitched gateway trace over this fleet looks exactly
+like one over the production fast lane. Untraced requests pay none of
+it.
 
 Stdout protocol: one ``CHAOS-NODE READY <node_id> <port>`` line once the
 lease is registered and the socket is listening; the stack spawner
@@ -38,12 +49,30 @@ import os
 import sys
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from gordo_tpu.observability import flight, telemetry, tracing
 from gordo_tpu.server import membership, resilience
 from gordo_tpu.util import faults
 
 _BURN_WINDOW = 200
+
+
+def _debug_enabled() -> bool:
+    # same gate as server/debug.py, inlined so the node keeps its
+    # fast-import promise (no werkzeug)
+    return os.environ.get("GORDO_TPU_DEBUG_ENDPOINTS", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+def _query_param(query: str, name: str):
+    for part in query.split("&"):
+        key, _, value = part.partition("=")
+        if key == name and value:
+            return urllib.parse.unquote(value)
+    return None
 
 
 def _slo_s() -> float:
@@ -68,6 +97,11 @@ class ChaosNode:
         self._latencies = collections.deque(maxlen=_BURN_WINDOW)
         self._loaded = set()
         self._lock = threading.Lock()
+        # traced requests only land here; the recent ring (default on for
+        # the drill fleet) keeps fast successes resolvable for stitching
+        self.flight = flight.FlightRecorder(
+            recent=flight.recent_capacity_from_env(default=32)
+        )
         node = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -90,7 +124,7 @@ class ChaosNode:
 
     # ------------------------------------------------------------ serving
     def handle(self, req: BaseHTTPRequestHandler) -> None:
-        path = req.path.split("?", 1)[0]
+        path, _, query = req.path.partition("?")
         if path == "/healthcheck":
             return self._json(req, 200, {"node": self.node_id, "ok": True})
         if path == "/debug/slo":
@@ -98,49 +132,81 @@ class ChaosNode:
         if path == "/chaos/breakers":
             return self._json(req, 200, {"node": self.node_id,
                                          "breakers": self._breaker_states()})
+        if path == "/debug/flight":
+            return self._flight(req, query)
         parts = path.split("/")
         if len(parts) >= 5 and parts[1] == "gordo" and parts[2] == "v0":
             return self._serve(req, machine=parts[4])
         return self._json(req, 404, {"error": f"no route {path}"})
 
+    def _flight(self, req: BaseHTTPRequestHandler, query: str) -> None:
+        if not _debug_enabled():
+            # indistinguishable from an unknown route, like server/debug.py
+            return self._json(req, 404, {"error": "no route /debug/flight"})
+        trace_id = _query_param(query, "trace")
+        if trace_id:
+            doc = self.flight.chrome_trace(trace_id)
+            if doc is None:
+                return self._json(req, 404, {"error": "trace not kept",
+                                             "trace_id": trace_id})
+            return self._json(req, 200, doc)
+        return self._json(req, 200, self.flight.chrome_trace())
+
     def _serve(self, req: BaseHTTPRequestHandler, machine: str) -> None:
         start = time.monotonic()
         self.hits += 1
+        traceparent = req.headers.get("traceparent")
+        if traceparent is None:
+            status, doc, extra = self._predict(machine)
+            self._latencies.append(time.monotonic() - start)
+            return self._json(req, status, doc, extra=extra)
+        with tracing.request_root(traceparent) as rtrace:
+            with telemetry.span("serve_request", method=req.command) as root:
+                root.set_attrs(endpoint="prediction", machine=machine,
+                               node=self.node_id)
+                status, doc, extra = self._predict(machine)
+                root.set_attrs(status=status)
+        duration = time.monotonic() - start
+        self._latencies.append(duration)
+        self.flight.observe(rtrace.collector, status, duration,
+                            endpoint="prediction", model=machine)
+        extra = list(extra) + [("X-Gordo-Trace", rtrace.trace_id)]
+        return self._json(req, status, doc, extra=extra)
+
+    def _predict(self, machine: str):
+        """The serving pipeline for one hit: (status, doc, extra headers).
+        Span structure mirrors the real fast lane — ``serve_batch_queue``
+        (admission + model load) wrapping ``serve_device_call``."""
         breaker = resilience.breaker_for(machine)
         if breaker is not None:
             info = breaker.allow()
             if info is not None:
                 header = ("Retry-After",
                           resilience.breaker_retry_after_header(info))
-                return self._json(req, 503, info, extra=[header])
+                return 503, info, [header]
         try:
-            with self._lock:
-                cold = machine not in self._loaded
-            if cold:
-                # first touch = artifact load; a wedge rule here is the
-                # slow-store stall, a permanent is a corrupt artifact
-                faults.fault_point("serve_model_load", machine=machine)
+            with telemetry.span("serve_batch_queue", machine=machine):
                 with self._lock:
-                    self._loaded.add(machine)
-            faults.fault_point("serve_predict", machine=machine)
-            faults.fault_point("serve_device_call", machine=machine)
-            time.sleep(_work_s())
+                    cold = machine not in self._loaded
+                if cold:
+                    # first touch = artifact load; a wedge rule here is the
+                    # slow-store stall, a permanent is a corrupt artifact
+                    faults.fault_point("serve_model_load", machine=machine)
+                    with self._lock:
+                        self._loaded.add(machine)
+                faults.fault_point("serve_predict", machine=machine)
+                with telemetry.span("serve_device_call", machine=machine):
+                    faults.fault_point("serve_device_call", machine=machine)
+                    time.sleep(_work_s())
         except Exception as exc:  # noqa: BLE001 — injected faults only
             resilience.record_breaker_failure(breaker, exc)
             transient = faults.is_transient(exc)
             status = 503 if transient else 500
             extra = [("Retry-After", "1")] if transient else []
-            self._latencies.append(time.monotonic() - start)
-            return self._json(
-                req, status,
-                {"error": str(exc), "node": self.node_id, "machine": machine},
-                extra=extra,
-            )
+            return status, {"error": str(exc), "node": self.node_id,
+                            "machine": machine}, extra
         resilience.record_breaker_success(breaker)
-        self._latencies.append(time.monotonic() - start)
-        return self._json(
-            req, 200, {"node": self.node_id, "machine": machine},
-        )
+        return 200, {"node": self.node_id, "machine": machine}, []
 
     # ---------------------------------------------------------- telemetry
     def _slo_doc(self) -> dict:

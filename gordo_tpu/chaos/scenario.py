@@ -70,6 +70,7 @@ INVARIANTS = (
     "breaker_scoped",          # open breakers ⊆ the poisoned model set
     "histogram_exact",         # merged histogram count == measured sends
     "one_rebuild_per_machine",  # drift queue depth == drifted machines
+    "stitched_trace",          # failover visible in one stitched trace
 )
 
 CHAFF_KINDS = ("slow_loris", "scanner")

@@ -224,14 +224,15 @@ class ChaosStack:
     def gateway_port(self) -> int:
         return self.gateway.server_port
 
-    def request(self, method: str, path: str, timeout: float = 10.0):
+    def request(self, method: str, path: str, timeout: float = 10.0,
+                headers: Optional[dict] = None):
         """One request through the gateway; returns (status, headers, body)
         with status -1 on transport errors."""
         conn = http.client.HTTPConnection(
             "127.0.0.1", self.gateway_port, timeout=timeout
         )
         try:
-            conn.request(method, path)
+            conn.request(method, path, headers=headers or {})
             resp = conn.getresponse()
             body = resp.read()
             return resp.status, {k.lower(): v for k, v in resp.getheaders()}, body

@@ -845,12 +845,94 @@ def chaos_list(scenario_dir: str):
                    f"phases={len(spec.phases)} invariants=[{checks}]")
 
 
+@click.command("trace")
+@click.argument("trace_id")
+@click.option("--host", default="127.0.0.1", show_default=True,
+              help="Gateway host (a node works too — you get its subtree)")
+@click.option("--port", default=5556, show_default=True, type=int,
+              help="Gateway port (``gordo run-gateway`` default)")
+@click.option("--out", type=click.Path(), default=None,
+              help="Also write the raw stitched Chrome-trace JSON here "
+                   "(open in Perfetto / chrome://tracing)")
+def trace_cli(trace_id: str, host: str, port: int, out: str):
+    """Fetch one request's stitched cross-node trace from a gateway.
+
+    Wraps ``GET /debug/flight?trace=<id>`` (``GORDO_TPU_DEBUG_ENDPOINTS``
+    must be on): the gateway returns its own span tree for the request
+    with each upstream node's subtree grafted under the proxy attempt
+    that hit it, and this prints that tree — indented, durations in ms,
+    node-side spans tagged with their node id. A partial stitch (dead
+    node, gated-off debug surface) is reported per node, not fatal.
+    """
+    import http.client
+
+    status, raw = 0, b""
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        conn.request("GET", f"/debug/flight?trace={trace_id}")
+        resp = conn.getresponse()
+        status, raw = resp.status, resp.read()
+    except OSError as exc:
+        click.echo(f"error: cannot reach {host}:{port} ({exc})", err=True)
+        sys.exit(2)
+    finally:
+        conn.close()
+    if status != 200:
+        click.echo(
+            f"error: {host}:{port} answered {status}: "
+            f"{raw[:200].decode(errors='replace')}",
+            err=True,
+        )
+        sys.exit(1)
+    doc = json.loads(raw)
+    if out:
+        with open(out, "w") as fh:
+            json.dump(doc, fh, indent=1)
+    events = doc.get("traceEvents") or []
+    known = {e.get("args", {}).get("span_id") for e in events}
+    children: dict = {}
+    roots = []
+    for event in events:
+        parent = event.get("args", {}).get("parent_span_id") or ""
+        if parent and parent in known:
+            children.setdefault(parent, []).append(event)
+        else:
+            roots.append(event)
+
+    def emit(event, depth):
+        args = dict(event.get("args") or {})
+        span_id = args.get("span_id")
+        node = args.pop("gordo_node", None)
+        attrs = " ".join(
+            f"{k}={args[k]}" for k in sorted(args)
+            if k not in ("trace_id", "span_id", "parent_span_id", "links")
+        )
+        where = f" @{node}" if node else ""
+        dur_ms = float(event.get("dur", 0.0)) / 1000.0
+        line = f"{'  ' * depth}{event.get('name')}{where} {dur_ms:.2f}ms"
+        click.echo(f"{line}  {attrs}".rstrip())
+        for child in sorted(children.get(span_id, ()),
+                            key=lambda c: c.get("ts", 0.0)):
+            emit(child, depth + 1)
+
+    click.echo(f"trace {trace_id}")
+    for root in sorted(roots, key=lambda e: e.get("ts", 0.0)):
+        emit(root, 1)
+    stitch = doc.get("gordoStitch") or {}
+    for entry in stitch.get("nodes", ()):
+        mark = "ok" if entry.get("ok") else f"MISSING ({entry.get('reason')})"
+        click.echo(f"stitch {entry.get('node')}: {mark}")
+    if stitch and not stitch.get("complete"):
+        click.echo("stitch: PARTIAL — some node subtrees are missing")
+
+
 gordo.add_command(build)
 gordo.add_command(batch_build)
 gordo.add_command(run_server_cli)
 gordo.add_command(run_gateway_cli)
 gordo.add_command(drift_rebuilder)
 gordo.add_command(chaos_cli)
+gordo.add_command(trace_cli)
 
 
 def _append_workflow_commands():

@@ -95,6 +95,12 @@ def _handle_response(resp: Any, resource_name: str = "") -> Any:
     trace_id = resp.headers.get("X-Gordo-Trace")
     if trace_id:
         msg = f"{msg} [trace {trace_id}]"
+    # when a gateway routed this request, name the node it landed on —
+    # together with the trace id that points at the one machine whose
+    # flight recorder holds the node-side subtree
+    gateway_node = resp.headers.get("X-Gordo-Gateway-Node")
+    if gateway_node:
+        msg = f"{msg} [via {gateway_node}]"
     try:
         detail = resp.json()
     except Exception:

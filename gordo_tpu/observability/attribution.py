@@ -225,7 +225,18 @@ def _components(
     walltime = phases.get("request_walltime")
     if walltime is not None:
         comps["server_other"] = float(walltime) - sum(comps.values())
-        comps["queue/transport"] = float(total) - float(walltime)
+        transport = float(total) - float(walltime)
+        # the gateway's own span-derived overhead (Server-Timing
+        # ``gateway_s``: routed wall minus upstream attempts) is part of
+        # the client-to-server gap, not node walltime — carve it out of
+        # queue/transport so a gateway regression shows under its own
+        # name. NOT in _CORE_PHASES: summing it into server_other would
+        # double-count time the node never saw.
+        gateway = phases.get("gateway")
+        if gateway is not None:
+            comps["gateway"] = float(gateway)
+            transport -= float(gateway)
+        comps["queue/transport"] = transport
     else:
         if "server_other" in phases:
             comps["server_other"] = float(phases["server_other"])

@@ -52,6 +52,22 @@ def capacity_from_env() -> int:
         return DEFAULT_CAPACITY
 
 
+def recent_capacity_from_env(default: int = 0) -> int:
+    """Size of the optional *recent* ring (``GORDO_TPU_FLIGHT_RECENT``):
+    every observed trace is kept there regardless of the tail-sampling
+    verdict, so ``find()`` can resolve a trace id that was neither
+    errored nor slow — what cross-node stitching and metric exemplars
+    need. 0 (the default for serving nodes) disables it; the gateway's
+    recorder defaults it on, since it only observes opted-in traces."""
+    raw = os.environ.get("GORDO_TPU_FLIGHT_RECENT")
+    if not raw:
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default
+
+
 def slow_threshold_env_s() -> Optional[float]:
     """The explicit slow knob (seconds), or None → adaptive."""
     raw = os.environ.get("GORDO_TPU_FLIGHT_SLOW_S")
@@ -67,13 +83,19 @@ def slow_threshold_env_s() -> Optional[float]:
 class FlightRecorder:
     """Bounded ring of kept traces; all methods thread-safe."""
 
-    def __init__(self, capacity: Optional[int] = None):
+    def __init__(
+        self, capacity: Optional[int] = None, recent: Optional[int] = None
+    ):
         capacity = capacity if capacity is not None else capacity_from_env()
+        recent = recent if recent is not None else recent_capacity_from_env()
         error_cap = max(1, capacity // 2)
         self._lock = threading.Lock()
         self._errors: "deque[Dict[str, Any]]" = deque(maxlen=error_cap)
         self._slow: "deque[Dict[str, Any]]" = deque(
             maxlen=max(1, capacity - error_cap)
+        )
+        self._recent: Optional["deque[Dict[str, Any]]"] = (
+            deque(maxlen=recent) if recent > 0 else None
         )
         self._durations: "deque[float]" = deque(maxlen=_SAMPLE_WINDOW)
         # out-of-band events (perf-sentinel fires, etc.): small bounded
@@ -124,11 +146,11 @@ class FlightRecorder:
         # request never raises the bar for itself)
         with self._lock:
             self._durations.append(duration_s)
-        if verdict is None or trace is None:
+        if trace is None or (verdict is None and self._recent is None):
             return None
         record = {
             "trace_id": trace.trace_id,
-            "class": verdict,
+            "class": verdict or "recent",
             "status": int(status),
             "endpoint": endpoint,
             "model": model,
@@ -137,6 +159,11 @@ class FlightRecorder:
             "dropped_spans": trace.dropped,
             "spans": [s.to_dict() for s in trace.snapshot()],
         }
+        if self._recent is not None:
+            with self._lock:
+                self._recent.append(record)
+        if verdict is None:
+            return None
         ring = self._errors if verdict == "error" else self._slow
         with self._lock:
             ring.append(record)
@@ -146,6 +173,19 @@ class FlightRecorder:
         metric_catalog.FLIGHT_OCCUPANCY.labels(cls="error").set(n_err)
         metric_catalog.FLIGHT_OCCUPANCY.labels(cls="slow").set(n_slow)
         return verdict
+
+    def find(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Newest kept record for ``trace_id`` — the tail-sampled rings
+        first, then the recent ring. None when the id was never kept."""
+        with self._lock:
+            rings = [list(self._errors), list(self._slow)]
+            if self._recent is not None:
+                rings.append(list(self._recent))
+        for ring in rings:
+            for record in reversed(ring):
+                if record["trace_id"] == trace_id:
+                    return record
+        return None
 
     def record_event(self, kind: str, payload: Dict[str, Any]) -> None:
         """Attach an out-of-band event (e.g. a perf-sentinel fire with
@@ -179,14 +219,26 @@ class FlightRecorder:
             records = list(self._errors) + list(self._slow)
         return sorted(records, key=lambda r: r["recorded_at"])
 
-    def chrome_trace(self) -> Dict[str, Any]:
+    def chrome_trace(
+        self, trace_id: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
         """The ring as one Chrome trace-event JSON document (open in
         Perfetto / ``chrome://tracing``): each kept request's spans on its
         originating thread lanes, trace/span ids and span-links in args.
         A ``gordoFlight`` sidecar lists the per-trace summaries (status,
-        class, duration) so the document is greppable without a UI."""
+        class, duration) so the document is greppable without a UI.
+
+        With ``trace_id`` the document is filtered to that one trace —
+        the shape cross-node stitching fetches — and None is returned
+        when the recorder never kept it."""
+        if trace_id is not None:
+            record = self.find(trace_id)
+            if record is None:
+                return None
+            records = [record]
+        else:
+            records = self.snapshot()
         events: List[Dict[str, Any]] = []
-        records = self.snapshot()
         for record in records:
             for span in record["spans"]:
                 args = {
@@ -226,13 +278,15 @@ class FlightRecorder:
                 {k: v for k, v in record.items() if k != "spans"}
                 for record in records
             ],
-            "gordoEvents": self.events(),
+            "gordoEvents": self.events() if trace_id is None else [],
         }
 
     def reset(self) -> None:
         with self._lock:
             self._errors.clear()
             self._slow.clear()
+            if self._recent is not None:
+                self._recent.clear()
             self._durations.clear()
             self._events.clear()
             self.seen = 0

@@ -58,7 +58,14 @@ def _timeseries(
             "legend": {"displayMode": "list", "placement": "bottom"},
         },
         "targets": [
-            {"expr": t["expr"], "legendFormat": t.get("legend", ""), "refId": chr(65 + i)}
+            {
+                "expr": t["expr"],
+                "legendFormat": t.get("legend", ""),
+                "refId": chr(65 + i),
+                # Grafana's per-target exemplar switch: overlays the
+                # OpenMetrics exemplar dots (trace_id-linked) on the series
+                **({"exemplar": True} if t.get("exemplar") else {}),
+            }
             for i, t in enumerate(targets)
         ],
     }
@@ -1029,6 +1036,51 @@ def gateway_dashboard() -> Dict[str, Any]:
             panel_id=10,
             x=_PANEL_W + 6,
             y=3 * _PANEL_H,
+        ),
+        _timeseries(
+            "Proxy latency p99 with trace exemplars",
+            [
+                {
+                    "expr": (
+                        "histogram_quantile(0.99, sum(rate("
+                        "gordo_gateway_proxy_seconds_bucket[5m]"
+                        ")) by (le))"
+                    ),
+                    "legend": "p99",
+                    "exemplar": True,
+                },
+            ],
+            panel_id=11,
+            x=0,
+            y=4 * _PANEL_H,
+            unit="s",
+            description=(
+                "Each exemplar dot carries a trace_id from the gateway's "
+                "flight recorder; follow it with `gordo trace <id>` or "
+                "GET /debug/flight?trace=<id> for the stitched "
+                "gateway+node span tree of that exact request"
+            ),
+        ),
+        _timeseries(
+            "Trace stitch outcomes",
+            [
+                {
+                    "expr": "sum(rate(gordo_gateway_trace_stitches_total"
+                    "[5m])) by (outcome)",
+                    "legend": "{{outcome}}",
+                }
+            ],
+            panel_id=12,
+            x=_PANEL_W,
+            y=4 * _PANEL_H,
+            unit="reqps",
+            description=(
+                "Cross-node stitch results from /debug/flight?trace=: "
+                "'full' grafted every upstream subtree, 'partial' lost a "
+                "node (dead or debug gate off), 'gateway_only' proxied "
+                "nothing, 'miss' means the trace aged out of the flight "
+                "recorder ring (raise GORDO_TPU_FLIGHT_RECENT)"
+            ),
         ),
     ]
     return _dashboard("Gordo TPU gateway", "gordo-tpu-gateway", panels)

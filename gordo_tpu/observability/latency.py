@@ -51,12 +51,17 @@ _MAX_BACKFILL = 10_000
 
 _QUANTILES = (0.50, 0.90, 0.99, 0.999)
 
+# per-histogram exemplar reservoir bound: enough to cover every occupied
+# bucket of a realistic latency distribution; when full, smaller-indexed
+# (faster) buckets are evicted first so the tail keeps its trace links
+_MAX_EXEMPLARS = 64
+
 
 class LatencyHistogram:
     """Sparse log-bucketed histogram of positive values (seconds)."""
 
     __slots__ = ("subbuckets", "_lock", "_buckets", "_count", "_sum",
-                 "_min", "_max")
+                 "_min", "_max", "_exemplars")
 
     def __init__(self, subbuckets: int = DEFAULT_SUBBUCKETS):
         if subbuckets < 2:
@@ -68,6 +73,10 @@ class LatencyHistogram:
         self._sum = 0.0
         self._min = math.inf
         self._max = 0.0
+        # bucket index -> (trace_id, value): the latest traced sample seen
+        # per bucket, so a tail bucket links to a real, recent trace; the
+        # dict is bounded to _MAX_EXEMPLARS entries (tail buckets win)
+        self._exemplars: Dict[int, tuple] = {}
 
     # ------------------------------------------------------------- indexing
     def _index(self, value: float) -> int:
@@ -87,10 +96,12 @@ class LatencyHistogram:
         return low, high
 
     # ------------------------------------------------------------ recording
-    def record(self, value: float) -> None:
+    def record(self, value: float, trace_id: Optional[str] = None) -> None:
         """Record one value (seconds). Non-finite / non-positive values are
         clamped to the range edge rather than raising: one bad sample in a
-        million-request load run must not kill the run."""
+        million-request load run must not kill the run. ``trace_id`` (when
+        the caller has one) becomes the bucket's exemplar — latest wins, so
+        an exemplar always names a trace recent enough to still resolve."""
         if not (value > _MIN_VALUE):  # False for NaN too
             value = _MIN_VALUE
         elif value > _MAX_VALUE:
@@ -104,16 +115,38 @@ class LatencyHistogram:
                 self._min = value
             if value > self._max:
                 self._max = value
+            if trace_id:
+                self._note_exemplar(index, trace_id, value)
+
+    def _note_exemplar(self, index: int, trace_id: str, value: float) -> None:
+        """Store ``(trace_id, value)`` for ``index``; caller holds the
+        lock. Over the cap, the smallest (fastest) exemplared bucket is
+        evicted — the slow tail is what exemplars exist to explain."""
+        if index not in self._exemplars and \
+                len(self._exemplars) >= _MAX_EXEMPLARS:
+            evict = min(self._exemplars)
+            if evict >= index:
+                return
+            del self._exemplars[evict]
+        self._exemplars[index] = (trace_id, value)
+
+    def exemplars(self) -> Dict[int, tuple]:
+        """{bucket index: (trace_id, value)} — a snapshot."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def record_with_expected_interval(
-        self, value: float, expected_interval: Optional[float]
+        self, value: float, expected_interval: Optional[float],
+        trace_id: Optional[str] = None,
     ) -> None:
         """HdrHistogram's coordinated-omission correction for CLOSED-loop
         measurement: record ``value``, then back-fill ``value - k *
         expected_interval`` for k=1.. while positive — the latencies of the
         requests the client *should* have issued while this one stalled the
-        loop. A server that freezes now inflates p99 instead of hiding it."""
-        self.record(value)
+        loop. A server that freezes now inflates p99 instead of hiding it.
+        Only the real sample carries the exemplar ``trace_id`` — the
+        back-filled ones are synthetic and have no trace."""
+        self.record(value, trace_id)
         if not expected_interval or expected_interval <= 0:
             return
         backfill = value - expected_interval
@@ -137,6 +170,7 @@ class LatencyHistogram:
             buckets = dict(other._buckets)
             count, total = other._count, other._sum
             low, high = other._min, other._max
+            exemplars = dict(other._exemplars)
         with self._lock:
             for index, n in buckets.items():
                 self._buckets[index] = self._buckets.get(index, 0) + n
@@ -146,6 +180,8 @@ class LatencyHistogram:
                 self._min = low
             if high > self._max:
                 self._max = high
+            for index, (trace_id, value) in exemplars.items():
+                self._note_exemplar(index, trace_id, value)
         return self
 
     @classmethod
@@ -221,7 +257,7 @@ class LatencyHistogram:
         """JSON-safe snapshot a child process can print and a parent can
         ``from_dict`` + ``merge`` (bucket keys stringified for JSON)."""
         with self._lock:
-            return {
+            payload: Dict[str, object] = {
                 "subbuckets": self.subbuckets,
                 "count": self._count,
                 "sum": self._sum,
@@ -229,6 +265,12 @@ class LatencyHistogram:
                 "max": self._max if self._count else None,
                 "buckets": {str(k): v for k, v in self._buckets.items()},
             }
+            if self._exemplars:
+                payload["exemplars"] = {
+                    str(k): [trace_id, value]
+                    for k, (trace_id, value) in self._exemplars.items()
+                }
+            return payload
 
     @classmethod
     def from_dict(cls, payload: Dict[str, object]) -> "LatencyHistogram":
@@ -241,4 +283,11 @@ class LatencyHistogram:
         maximum = payload.get("max")
         out._min = float(minimum) if minimum is not None else math.inf
         out._max = float(maximum) if maximum is not None else 0.0
+        # optional since the exemplar plane landed: payloads from older
+        # writers simply carry none
+        for key, entry in (payload.get("exemplars") or {}).items():
+            try:
+                out._exemplars[int(key)] = (str(entry[0]), float(entry[1]))
+            except (TypeError, ValueError, IndexError):
+                continue
         return out

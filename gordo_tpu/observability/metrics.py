@@ -447,6 +447,14 @@ GATEWAY_BREAKER_STATE = telemetry.gauge(
     "(0.5 half-open probe window)",
     ("node",),
 )
+GATEWAY_TRACE_STITCHES = telemetry.counter(
+    "gordo_gateway_trace_stitches_total",
+    "Cross-node trace-stitch requests (/debug/flight?trace=<id>), by "
+    "outcome: full (every node subtree grafted), partial (some nodes "
+    "unreachable/gated — the stitched doc says which), gateway_only (no "
+    "node subtree could be fetched), miss (the gateway never kept the id)",
+    ("outcome",),
+)
 GATEWAY_PREWARMS = telemetry.counter(
     "gordo_gateway_prewarm_total",
     "Successor pre-warm touches issued when a node starts draining "

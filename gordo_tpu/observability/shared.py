@@ -52,6 +52,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from gordo_tpu.observability import telemetry
 from gordo_tpu.observability.telemetry import (
+    MAX_EXEMPLARS_PER_FAMILY,
+    _format_exemplar,
     _format_float,
     _render_labels,
 )
@@ -187,6 +189,19 @@ def snapshot_payload(
                 [list(key), [list(counts), total]]
                 for key, (counts, total) in metric.snapshot()
             ]
+            # optional (schema-1 compatible: readers ignore unknown keys):
+            # exemplar trace links per series, [key, [[bucket_idx,
+            # trace_id, value, unix_ts], ...]]
+            exemplars = metric.exemplars()
+            if exemplars:
+                entry["exemplars"] = [
+                    [
+                        list(key),
+                        [[i, tid, value, ts]
+                         for i, (tid, value, ts) in per_bucket.items()],
+                    ]
+                    for key, per_bucket in exemplars.items()
+                ]
         else:
             entry["series"] = [
                 [list(key), value] for key, value in metric.snapshot()
@@ -372,9 +387,24 @@ def merge_shards(shards: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 ),
                 "series": {},
                 "per_worker": {},
+                "exemplars": {},
             })
             if family["kind"] != kind:
                 continue  # name collision across kinds: first wins
+            if kind == "histogram":
+                for raw_key, entries in entry.get("exemplars", ()):
+                    key = tuple(str(part) for part in raw_key)
+                    for item in entries:
+                        try:
+                            index, tid, value, ts = item
+                            merged = (str(tid), float(value), float(ts))
+                        except (TypeError, ValueError):
+                            continue
+                        prior = family["exemplars"].get((key, int(index)))
+                        # newest traced observation wins across workers,
+                        # so a rendered exemplar still resolves somewhere
+                        if prior is None or merged[2] > prior[2]:
+                            family["exemplars"][(key, int(index))] = merged
             for raw_key, raw_value in entry.get("series", ()):
                 key = tuple(str(part) for part in raw_key)
                 if kind == "histogram":
@@ -433,15 +463,26 @@ def render_fleet_text(directory: Optional[str] = None) -> Optional[str]:
         lines.append(f"# TYPE {name} {family['kind']}")
         labelnames = family["labelnames"]
         if family["kind"] == "histogram":
+            all_exemplars = sorted(
+                family.get("exemplars", {}).items(),
+                key=lambda item: -item[1][2],  # newest first
+            )
+            exemplars = dict(all_exemplars[:MAX_EXEMPLARS_PER_FAMILY])
             for key in sorted(family["series"]):
                 counts, total = family["series"][key]
                 cumulative = 0
-                for bound, count in zip(family["buckets"], counts):
+                for i, (bound, count) in enumerate(
+                    zip(family["buckets"], counts)
+                ):
                     cumulative += count
                     labels = _render_labels(
                         labelnames, key, extra=(("le", _format_float(bound)),)
                     )
-                    lines.append(f"{name}_bucket{labels} {cumulative}")
+                    line = f"{name}_bucket{labels} {cumulative}"
+                    exemplar = exemplars.get((key, i))
+                    if exemplar is not None:
+                        line += _format_exemplar(*exemplar)
+                    lines.append(line)
                 labels = _render_labels(labelnames, key)
                 lines.append(f"{name}_sum{labels} {_format_float(total)}")
                 lines.append(f"{name}_count{labels} {cumulative}")

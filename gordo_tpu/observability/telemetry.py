@@ -102,6 +102,40 @@ def _escape_label_value(value: str) -> str:
     )
 
 
+# rendered OpenMetrics exemplars are capped per metric family (newest
+# first) so the exposition stays bounded however many label series exist;
+# scripts/lint_metric_names.py enforces the same cap on the rendered text
+MAX_EXEMPLARS_PER_FAMILY = 16
+
+
+def _format_exemplar(trace_id: str, value: float, ts: float) -> str:
+    """OpenMetrics exemplar suffix for a ``_bucket`` sample line:
+    ``# {trace_id="<id>"} <value> <unix_ts>``. ``trace_id`` is the only
+    exemplar label this codebase emits (unbounded label values belong in
+    exemplars, never in metric labels — the lint owns both rules)."""
+    return (
+        f' # {{trace_id="{_escape_label_value(trace_id)}"}} '
+        f"{_format_float(value)} {ts:.3f}"
+    )
+
+
+def _capped_exemplars(metric: "_Metric") -> Dict[Tuple[Any, int], Tuple]:
+    """{(label key, bucket index): (trace_id, value, ts)} for one
+    histogram family, newest ``MAX_EXEMPLARS_PER_FAMILY`` only."""
+    if metric.kind != "histogram":
+        return {}
+    flat = [
+        (key, index, entry)
+        for key, per_bucket in metric.exemplars().items()
+        for index, entry in per_bucket.items()
+    ]
+    flat.sort(key=lambda item: -item[2][2])  # newest first
+    return {
+        (key, index): entry
+        for key, index, entry in flat[:MAX_EXEMPLARS_PER_FAMILY]
+    }
+
+
 def _render_labels(
     labelnames: Sequence[str],
     labelvalues: Sequence[str],
@@ -117,11 +151,15 @@ def _render_labels(
 
 
 class _HistogramState:
-    __slots__ = ("counts", "sum")
+    __slots__ = ("counts", "sum", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets
         self.sum = 0.0
+        # bucket index -> (trace_id, value, unix_ts): latest traced
+        # observation per bucket; None until the first one (the common
+        # untraced series never allocates the dict)
+        self.exemplars: Optional[Dict[int, Tuple[str, float, float]]] = None
 
 
 class _Metric:
@@ -248,6 +286,13 @@ class Histogram(_Metric):
 
     def _observe(self, key: Tuple[str, ...], value: float) -> None:
         value = float(value)
+        # exemplar capture is implicit: an observation made under an
+        # active request trace links its bucket to that trace id (latest
+        # wins — a rendered exemplar should still resolve in the flight
+        # recorder). One contextvar read; untraced paths pay nothing else.
+        ctx = _request_tracing.current()
+        trace_id = ctx.trace_id if ctx is not None \
+            and ctx.collector is not None else None
         with self._lock:
             state = self._values.get(key)
             if state is None:
@@ -255,8 +300,24 @@ class Histogram(_Metric):
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     state.counts[i] += 1
+                    if trace_id is not None:
+                        if state.exemplars is None:
+                            state.exemplars = {}
+                        state.exemplars[i] = (trace_id, value, time.time())
                     break
             state.sum += value
+
+    def exemplars(
+        self,
+    ) -> Dict[Tuple[str, ...], Dict[int, Tuple[str, float, float]]]:
+        """{label key: {bucket index: (trace_id, value, unix_ts)}} for
+        every series that has captured at least one exemplar."""
+        with self._lock:
+            return {
+                key: dict(state.exemplars)
+                for key, state in self._values.items()
+                if isinstance(state, _HistogramState) and state.exemplars
+            }
 
     def count(self, **labelkw: str) -> int:
         with self._lock:
@@ -344,20 +405,25 @@ class MetricsRegistry:
             help_text = metric.help.replace("\\", r"\\").replace("\n", r"\n")
             lines.append(f"# HELP {metric.name} {help_text}")
             lines.append(f"# TYPE {metric.name} {metric.kind}")
+            exemplars = _capped_exemplars(metric)
             for key, value in metric.snapshot():
                 if metric.kind == "histogram":
                     counts, total = value
                     cumulative = 0
-                    for bound, count in zip(metric.buckets, counts):
+                    for i, (bound, count) in enumerate(
+                        zip(metric.buckets, counts)
+                    ):
                         cumulative += count
                         labels = _render_labels(
                             metric.labelnames,
                             key,
                             extra=(("le", _format_float(bound)),),
                         )
-                        lines.append(
-                            f"{metric.name}_bucket{labels} {cumulative}"
-                        )
+                        line = f"{metric.name}_bucket{labels} {cumulative}"
+                        exemplar = exemplars.get((key, i))
+                        if exemplar is not None:
+                            line += _format_exemplar(*exemplar)
+                        lines.append(line)
                     labels = _render_labels(metric.labelnames, key)
                     lines.append(f"{metric.name}_sum{labels} "
                                  f"{_format_float(total)}")

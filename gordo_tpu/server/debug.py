@@ -100,7 +100,7 @@ def dispatch(endpoint: str, config: Dict[str, Any], request=None) -> Response:
         # invisible unless explicitly enabled
         return Response("Not Found", status=404)
     if endpoint == "debug_flight":
-        return flight_view()
+        return flight_view(request)
     if endpoint == "debug_vars":
         return vars_view(config)
     if endpoint == "debug_slo":
@@ -117,13 +117,26 @@ def dispatch(endpoint: str, config: Dict[str, Any], request=None) -> Response:
 
 
 # -------------------------------------------------------------- /debug/flight
-def flight_view() -> Response:
+def flight_view(request=None) -> Response:
     """The flight ring as Chrome trace JSON, now with a ``gordoProfile``
     sidecar: the steady profiler's collapsed stacks keyed to the worst
     kept trace, so the evidence of *what the CPU was doing* ships next
-    to the evidence of *which requests were bad*."""
+    to the evidence of *which requests were bad*.
+
+    ``?trace=<id>`` filters to that one trace's subtree — the shape the
+    gateway's cross-node stitcher fetches — answering 404 when this
+    node's recorder never kept the id."""
     from gordo_tpu.observability import profiler
 
+    trace_id = request.args.get("trace") if request is not None else None
+    if trace_id:
+        payload = flight.default_recorder().chrome_trace(trace_id)
+        if payload is None:
+            return _json(
+                {"error": "trace not kept", "trace_id": trace_id},
+                status=404,
+            )
+        return _json(payload)
     payload = flight.default_recorder().chrome_trace()
     worst = flight.default_recorder().worst_trace()
     payload["gordoProfile"] = {

@@ -73,7 +73,7 @@ except ImportError:  # pragma: no cover - environment-dependent
     from gordo_tpu.util import _simplejson as simplejson
 
 from gordo_tpu.observability import metrics as metric_catalog
-from gordo_tpu.observability import shared, telemetry
+from gordo_tpu.observability import flight, shared, telemetry, tracing
 from gordo_tpu.server import membership, resilience
 from gordo_tpu.server.fastlane import (
     EventLoopServer,
@@ -116,6 +116,15 @@ def vnode_count() -> int:
 def hedge_budget_ms() -> float:
     """Minimum remaining request deadline (ms) worth spending a hedge on."""
     return _env_float("GORDO_TPU_GATEWAY_HEDGE_MS", 50.0)
+
+
+def trace_all_enabled() -> bool:
+    """``GORDO_TPU_GATEWAY_TRACE``: trace every routed request, not just
+    those arriving with a ``traceparent``. Off by default — the untraced
+    hot path stays allocation-identical to the pre-trace gateway."""
+    return os.environ.get("GORDO_TPU_GATEWAY_TRACE", "").lower() in (
+        "1", "true", "yes", "on",
+    )
 
 
 class _UDSHTTPConnection(http.client.HTTPConnection):
@@ -320,6 +329,14 @@ class GatewayServer(EventLoopServer):
         self.prewarm_enabled = os.environ.get(
             "GORDO_TPU_GATEWAY_PREWARM", "1"
         ).lower() not in ("0", "false", "no")
+        self.trace_all = trace_all_enabled()
+        # gateway-local flight recorder: traced requests are opted in, so
+        # the recent ring defaults ON here (successful hedged requests
+        # must stay resolvable for stitching and metric exemplars even
+        # though tail sampling would drop them)
+        self.flight = flight.FlightRecorder(
+            recent=flight.recent_capacity_from_env(default=32)
+        )
 
         self._live: Dict[str, membership.NodeInfo] = {}
         self._draining: set = set()
@@ -530,10 +547,10 @@ class GatewayServer(EventLoopServer):
         for node_id in order:
             node = live.get(node_id)
             if node is None:
-                skipped.append(node_id)
+                skipped.append(f"{node_id}:dead")
                 continue
             if not self._breaker(node_id).allow():
-                skipped.append(node_id)
+                skipped.append(f"{node_id}:breaker")
                 continue
             if node_id in draining:
                 drained.append(node)
@@ -554,11 +571,57 @@ class GatewayServer(EventLoopServer):
         started = timeit.default_timer()
         raw_path, _, query = target.partition("?")
         path = unquote(raw_path)
-        local = self._local_response(method, path)
+        local = self._local_response(method, path, query)
         if local is not None:
             status, out_headers, out_body = local
             return _serialize(status, out_headers, out_body, keep_alive=keep)
+        traceparent = headers.get("traceparent")
+        if traceparent is None and not self.trace_all:
+            # untraced fast path: no tracing-module calls, no span
+            # objects, no flight observation — allocation-identical to
+            # the pre-trace gateway (tracemalloc-pinned in tests)
+            status, out_headers, out_body = self._route_upstream(
+                method, raw_path, path, query, headers, body, started, None
+            )
+            return _serialize(status, out_headers, out_body, keep_alive=keep)
+        with tracing.request_root(traceparent, collect=True) as rctx:
+            with telemetry.span("gateway_request", method=method) as root:
+                status, out_headers, out_body = self._route_upstream(
+                    method, raw_path, path, query, headers, body,
+                    started, rctx,
+                )
+                root.set_attrs(status=status)
+            duration = timeit.default_timer() - started
+            # the gateway's own contribution: wall time minus the time
+            # spent inside upstream attempts — what bench_compare's
+            # `gateway` phase row decomposes
+            upstream_s = sum(
+                span.duration
+                for span in rctx.collector.snapshot()
+                if span.name == "gateway_upstream_attempt"
+            )
+            out_headers = list(out_headers)
+            if not any(
+                name.lower() == "x-gordo-trace" for name, _ in out_headers
+            ):
+                out_headers.append(("X-Gordo-Trace", rctx.trace_id))
+            out_headers.append((
+                "Server-Timing",
+                f"gateway_s;dur={max(0.0, duration - upstream_s)}",
+            ))
+            self.flight.observe(
+                rctx.collector, status, duration, endpoint=path
+            )
+        return _serialize(status, out_headers, out_body, keep_alive=keep)
 
+    def _route_upstream(self, method: str, raw_path: str, path: str,
+                        query: str, headers: Dict[str, str], body: bytes,
+                        started: float, rctx) -> Tuple[int, list, bytes]:
+        """Place and proxy one request; returns ``(status, headers,
+        body)`` for :func:`_serialize`. ``rctx`` is the request's
+        ``TraceContext`` on the traced path, None on the hot path — every
+        span/record call is gated on it so the untraced path touches no
+        tracing machinery at all."""
         machine, project = self._placement_key(path)
         key = machine or path
         try:
@@ -574,27 +637,34 @@ class GatewayServer(EventLoopServer):
             metric_catalog.GATEWAY_REQUESTS.labels(
                 node="none", status=str(status)
             ).inc()
-            return _serialize(
-                status, out_headers,
-                simplejson.dumps({"error": str(exc)}), keep_alive=keep,
-            )
+            return status, out_headers, simplejson.dumps({"error": str(exc)})
         if machine is not None and project is not None:
             self._note_machine(machine, project)
 
         deadline_ms = resilience.deadline_ms_from(_Headers(headers))
-        candidates, _skipped = self._viable_nodes(key)
+        if rctx is not None:
+            with telemetry.span(
+                "gateway_route_resolve", machine=machine or key
+            ) as resolve_span:
+                candidates, skipped = self._viable_nodes(key)
+                resolve_span.set_attrs(
+                    candidates=",".join(n.node_id for n in candidates),
+                    skipped=",".join(skipped),
+                )
+        else:
+            candidates, skipped = self._viable_nodes(key)
         if not candidates:
             retry_after = max(1, int(self.view.timeout_s / 2))
             metric_catalog.GATEWAY_REQUESTS.labels(
                 node="none", status="503"
             ).inc()
-            return _serialize(
-                503,
-                [("Content-Type", "application/json"),
-                 ("Retry-After", str(retry_after))],
-                simplejson.dumps({"error": "no live serving nodes"}),
-                keep_alive=keep,
-            )
+            doc = {"error": "no live serving nodes"}
+            if rctx is not None:
+                doc["gateway_trace"] = rctx.trace_id
+            return 503, [
+                ("Content-Type", "application/json"),
+                ("Retry-After", str(retry_after)),
+            ], simplejson.dumps(doc)
 
         path_q = raw_path + (("?" + query) if query else "")
         last_exc: Optional[BaseException] = None
@@ -603,23 +673,62 @@ class GatewayServer(EventLoopServer):
         for attempt, node in enumerate(candidates[:2]):
             if attempt:
                 if not self._hedge_allowed(deadline_ms, started):
+                    if rctx is not None:
+                        tracing.record_into(
+                            tracing.current(), "gateway_retry_decision",
+                            tracing.monotonic(), 0.0,
+                            decision="hedge_denied",
+                            reason="deadline_budget", node=node.node_id,
+                        )
                     break
                 reason = "connect" if last_exc is not None else "status_503"
                 metric_catalog.GATEWAY_HEDGES.labels(reason=reason).inc()
                 metric_catalog.GATEWAY_FAILOVERS.labels(
                     node=candidates[0].node_id
                 ).inc()
+                if rctx is not None:
+                    tracing.record_into(
+                        tracing.current(), "gateway_retry_decision",
+                        tracing.monotonic(), 0.0,
+                        decision="hedge", reason=reason,
+                        node=node.node_id,
+                        failed_node=candidates[0].node_id,
+                    )
             breaker = self._breaker(node.node_id)
-            try:
-                status, up_headers, up_body = self._proxy_once(
-                    node, method, path_q, headers, body, deadline_ms, started
-                )
-            except Exception as exc:  # noqa: BLE001 — connect/read/injected
-                last_exc = exc
-                breaker.record_failure(exc)
+            proxy_exc: Optional[BaseException] = None
+            if rctx is not None:
+                # hedge arms are SIBLING spans under the gateway root
+                # (each attempt span closes before the next opens), tagged
+                # with the node id and the lane _proxy_once actually used
+                with telemetry.span(
+                    "gateway_upstream_attempt",
+                    node=node.node_id, attempt=attempt,
+                ) as attempt_span:
+                    try:
+                        status, up_headers, up_body = self._proxy_once(
+                            node, method, path_q, headers, body,
+                            deadline_ms, started, span=attempt_span,
+                        )
+                        attempt_span.set_attrs(status=status)
+                    except Exception as exc:  # noqa: BLE001
+                        attempt_span.set_attrs(
+                            error=str(exc) or type(exc).__name__
+                        )
+                        proxy_exc = exc
+            else:
+                try:
+                    status, up_headers, up_body = self._proxy_once(
+                        node, method, path_q, headers, body,
+                        deadline_ms, started,
+                    )
+                except Exception as exc:  # noqa: BLE001 — connect/injected
+                    proxy_exc = exc
+            if proxy_exc is not None:
+                last_exc = proxy_exc
+                breaker.record_failure(proxy_exc)
                 logger.warning(
                     "gateway: upstream %s failed for %s %s: %s",
-                    node.node_id, method, path, exc,
+                    node.node_id, method, path, proxy_exc,
                 )
                 continue
             if status == 503 and attempt == 0 and len(candidates) > 1:
@@ -628,6 +737,12 @@ class GatewayServer(EventLoopServer):
                 breaker.record_failure(faults.TransientFault("upstream 503"))
                 last_exc = None
                 fallback_response = (status, up_headers, up_body)
+                if rctx is not None:
+                    tracing.record_into(
+                        tracing.current(), "gateway_retry_decision",
+                        tracing.monotonic(), 0.0,
+                        decision="hedge_on_503", node=node.node_id,
+                    )
                 continue
             if status >= 500:
                 breaker.record_failure(faults.TransientFault(f"upstream {status}"))
@@ -647,7 +762,7 @@ class GatewayServer(EventLoopServer):
             out_headers.append(("X-Gordo-Gateway-Node", node.node_id))
             if machine is not None and status < 300:
                 self._note_revision(machine, up_headers)
-            return _serialize(status, out_headers, up_body, keep_alive=keep)
+            return status, out_headers, up_body
 
         if fallback_response is not None:
             status, up_headers, up_body = fallback_response
@@ -661,19 +776,34 @@ class GatewayServer(EventLoopServer):
             out_headers.append(
                 ("X-Gordo-Gateway-Node", candidates[0].node_id)
             )
-            return _serialize(status, out_headers, up_body, keep_alive=keep)
+            if rctx is not None:
+                up_body = self._quote_trace(up_body, rctx.trace_id)
+            return status, out_headers, up_body
         metric_catalog.GATEWAY_REQUESTS.labels(
             node="none", status="502"
         ).inc()
-        return _serialize(
-            502,
-            [("Content-Type", "application/json")],
-            simplejson.dumps({
-                "error": "all replicas failed",
-                "detail": str(last_exc) if last_exc else "",
-            }),
-            keep_alive=keep,
-        )
+        doc = {
+            "error": "all replicas failed",
+            "detail": str(last_exc) if last_exc else "",
+        }
+        if rctx is not None:
+            doc["gateway_trace"] = rctx.trace_id
+        return 502, [("Content-Type", "application/json")], simplejson.dumps(doc)
+
+    @staticmethod
+    def _quote_trace(body, trace_id: str):
+        """Name the gateway trace id inside an upstream error body (the
+        saved-503 fallback) so the operator's next step — ``gordo trace
+        <id>`` — is in the payload itself, not just a header. Best-effort:
+        a non-JSON body passes through untouched."""
+        try:
+            doc = json.loads(body)
+        except (TypeError, ValueError):
+            return body
+        if not isinstance(doc, dict) or "gateway_trace" in doc:
+            return body
+        doc["gateway_trace"] = trace_id
+        return json.dumps(doc)
 
     def _hedge_allowed(self, deadline_ms: Optional[float],
                        started: float) -> bool:
@@ -749,10 +879,15 @@ class GatewayServer(EventLoopServer):
 
     def _proxy_once(self, node: membership.NodeInfo, method: str,
                     path_q: str, headers: Dict[str, str], body: bytes,
-                    deadline_ms: Optional[float], started: float):
+                    deadline_ms: Optional[float], started: float,
+                    span=None):
         """One upstream attempt over a pooled keep-alive connection;
         returns (status, header list, body bytes) or raises on
-        connection-level failure (the hedge trigger)."""
+        connection-level failure (the hedge trigger). ``span`` is the
+        surrounding attempt span on the traced path (None otherwise): it
+        receives the lane actually used (TCP vs UDS) and any in-attempt
+        retry attrs, and its presence gates the upstream ``traceparent``
+        injection that parents node-side ``serve_request`` trees here."""
         faults.fault_point("node_partition", machine=node.node_id)
         read_timeout = self.upstream_timeout_s
         if deadline_ms is not None:
@@ -766,8 +901,20 @@ class GatewayServer(EventLoopServer):
         }
         fwd["host"] = node.address
         fwd["connection"] = "keep-alive"
+        if span is not None:
+            # the ambient context is this attempt's span, so the node's
+            # serve_request root parents under THIS hedge arm — replacing
+            # any client-supplied traceparent (same trace id, new parent)
+            ctx = tracing.current()
+            if ctx is not None:
+                fwd["traceparent"] = tracing.format_traceparent(ctx)
         conn = self._upstream_conn(node)
         was_pooled = conn.sock is not None
+        if span is not None:
+            span.set_attrs(
+                lane="uds" if isinstance(conn, _UDSHTTPConnection)
+                else "tcp",
+            )
         tried_tcp = False
         while True:
             try:
@@ -787,6 +934,12 @@ class GatewayServer(EventLoopServer):
                     # retry against the SAME node before the hedge fires
                     was_pooled = False
                     conn = self._upstream_conn(node)
+                    if span is not None:
+                        span.set_attrs(
+                            stale_retry=True,
+                            lane="uds" if isinstance(conn, _UDSHTTPConnection)
+                            else "tcp",
+                        )
                     continue
                 if isinstance(conn, _UDSHTTPConnection) and not tried_tcp:
                     # a broken Unix-domain lane (stale advertised path,
@@ -794,6 +947,8 @@ class GatewayServer(EventLoopServer):
                     # node's TCP address before spending a hedge
                     tried_tcp = True
                     conn = self._upstream_conn(node, force_tcp=True)
+                    if span is not None:
+                        span.set_attrs(tcp_fallback=True, lane="tcp")
                     continue
                 raise
         if resp.will_close:
@@ -801,7 +956,7 @@ class GatewayServer(EventLoopServer):
         return resp.status, resp.getheaders(), data
 
     # ------------------------------------------------------- local endpoints
-    def _local_response(self, method: str, path: str):
+    def _local_response(self, method: str, path: str, query: str = ""):
         if path in ("/healthcheck", "/healthcheck/"):
             return 200, [("Content-Type", "application/json")], simplejson.dumps(
                 {"gordo-gateway": "ok", "nodes": len(self.ring.nodes)}
@@ -817,7 +972,122 @@ class GatewayServer(EventLoopServer):
             return 200, [("Content-Type", "application/json")], json.dumps(
                 self.status(), sort_keys=True
             )
+        if path in ("/debug/flight", "/debug/flight/"):
+            from gordo_tpu.server import debug
+
+            if not debug.enabled():
+                # indistinguishable from an unknown (proxied) path:
+                # fall through to routing, which will 503/404 upstream
+                return None
+            trace_id = None
+            for part in query.split("&"):
+                name, _, value = part.partition("=")
+                if name == "trace" and value:
+                    trace_id = unquote(value)
+            if trace_id:
+                return self._stitched_flight(trace_id)
+            doc = self.flight.chrome_trace()
+            return 200, [("Content-Type", "application/json")], \
+                simplejson.dumps(doc, ignore_nan=True)
         return None
+
+    # ----------------------------------------------------- trace stitching
+    def _stitched_flight(self, trace_id: str):
+        """``GET /debug/flight?trace=<id>``: ONE stitched Chrome-trace
+        document — the gateway's own span tree plus the node-side
+        subtrees fetched live from every node named in its
+        ``gateway_upstream_attempt`` spans. Partial results are explicit,
+        never fatal: a dead node or a gated-off node debug surface
+        becomes a ``gordoStitch`` entry, not an error. Cross-process span
+        linkage is by ids (the injected traceparent), not timestamps —
+        each process's ``ts`` offsets are its own monotonic clock."""
+        doc = self.flight.chrome_trace(trace_id)
+        if doc is None:
+            metric_catalog.GATEWAY_TRACE_STITCHES.labels(
+                outcome="miss"
+            ).inc()
+            return 404, [("Content-Type", "application/json")], \
+                simplejson.dumps({
+                    "error": "trace not kept by the gateway",
+                    "trace_id": trace_id,
+                })
+        record = self.flight.find(trace_id)
+        node_ids: List[str] = []
+        for span in record["spans"]:
+            node = (span.get("attrs") or {}).get("node")
+            if (
+                span["name"] == "gateway_upstream_attempt"
+                and node and node not in node_ids
+            ):
+                node_ids.append(node)
+        with self._state_lock:
+            live = dict(self._live)
+        stitched = []
+        fetched = 0
+        for node_id in node_ids:
+            node = live.get(node_id)
+            if node is None:
+                stitched.append({
+                    "node": node_id, "ok": False,
+                    "reason": "not in live membership",
+                })
+                continue
+            subdoc, reason = self._fetch_node_trace(node, trace_id)
+            if subdoc is None:
+                stitched.append(
+                    {"node": node_id, "ok": False, "reason": reason}
+                )
+                continue
+            events = subdoc.get("traceEvents") or []
+            for event in events:
+                event.setdefault("args", {})["gordo_node"] = node_id
+            doc["traceEvents"].extend(events)
+            doc["gordoFlight"].extend(subdoc.get("gordoFlight") or [])
+            stitched.append(
+                {"node": node_id, "ok": True, "events": len(events)}
+            )
+            fetched += 1
+        doc["gordoStitch"] = {
+            "trace_id": trace_id,
+            "nodes": stitched,
+            "complete": fetched == len(node_ids),
+        }
+        outcome = (
+            "full" if fetched == len(node_ids)
+            else ("partial" if fetched else "gateway_only")
+        )
+        metric_catalog.GATEWAY_TRACE_STITCHES.labels(outcome=outcome).inc()
+        return 200, [("Content-Type", "application/json")], \
+            simplejson.dumps(doc, ignore_nan=True)
+
+    def _fetch_node_trace(self, node: membership.NodeInfo, trace_id: str):
+        """One node's subtree for ``trace_id`` via its own
+        ``/debug/flight?trace=`` — ``(doc, "")`` or ``(None, reason)``;
+        a node dying mid-fetch (torn stitch) is a reason, not a raise."""
+        try:
+            conn = http.client.HTTPConnection(
+                node.host, node.port,
+                timeout=max(0.5, self.connect_timeout_s),
+            )
+            try:
+                conn.request("GET", f"/debug/flight?trace={trace_id}")
+                resp = conn.getresponse()
+                payload = resp.read()
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as exc:
+            return None, f"unreachable ({type(exc).__name__})"
+        if resp.status == 404:
+            return None, "trace not kept (or node debug endpoints off)"
+        if resp.status != 200:
+            return None, f"status {resp.status}"
+        try:
+            subdoc = json.loads(payload)
+        except ValueError:
+            return None, "unparseable response"
+        if not isinstance(subdoc, dict):
+            return None, "unparseable response"
+        return subdoc, ""
 
     def status(self) -> dict:
         """The /gateway/status document: membership + ring + health."""

@@ -30,6 +30,13 @@ Three checks:
    deleted metric renders an empty panel silently — at exactly the
    moment an operator stares at it. ``_bucket``/``_sum``/``_count``
    suffixes resolve to their histogram family.
+5. **Exposition exemplar discipline** (``--exposition``) — OpenMetrics
+   exemplars in a rendered /metrics exposition must carry exactly the
+   ``trace_id`` label (exemplars exist to link a bucket to the flight
+   recorder, nothing else rides along), sit only on ``_bucket`` samples,
+   and number at most ``--max-exemplars-per-family`` per metric family
+   (the renderer's cap; more means the renderer's bound regressed and
+   the scrape payload grows per-request).
 
 Checked call shapes: any call to ``Counter``/``Gauge``/``Histogram``
 (prometheus_client or telemetry classes) or the telemetry factory
@@ -40,7 +47,8 @@ at runtime.
 
 Usage: ``python scripts/lint_metric_names.py [root ...]
 [--catalog PATH --refs PATH ...]
-[--dashboards DIR --dashboard-catalogs PATH ...]`` (default roots:
+[--dashboards DIR --dashboard-catalogs PATH ...]
+[--exposition FILE ... [--max-exemplars-per-family N]]`` (default roots:
 ``gordo_tpu``; with default roots the catalog check runs against
 ``gordo_tpu/observability/metrics.py`` vs ``docs`` +
 ``gordo_tpu/observability/grafana.py`` + ``README.md``, and the
@@ -56,7 +64,7 @@ import json
 import pathlib
 import re
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 _FACTORY_NAMES = {
     "Counter", "Gauge", "Histogram", "Summary",
@@ -90,6 +98,17 @@ _DEFAULT_DASHBOARD_CATALOGS = (
 _METRIC_REF_RE = re.compile(r"\bgordo_[a-z0-9_]+")
 # exposition suffixes a histogram family answers for in PromQL
 _HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+# exemplar discipline: the renderer's per-family cap (keep in sync with
+# telemetry.MAX_EXEMPLARS_PER_FAMILY), and the only label an exemplar may
+# carry — its whole job is linking a bucket to the flight recorder
+_MAX_EXEMPLARS_PER_FAMILY = 16
+_EXEMPLAR_LABELS = ("trace_id",)
+# `name{labels} value # {trace_id="..."} exemplar_value [timestamp]`
+_EXEMPLAR_SUFFIX_RE = re.compile(
+    r"#\s*\{(?P<labels>[^}]*)\}\s*(?P<value>\S+)(?:\s+(?P<ts>\S+))?\s*$"
+)
+_EXEMPLAR_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"')
 
 
 def _call_name(node: ast.Call) -> Optional[str]:
@@ -262,6 +281,57 @@ def find_unknown_dashboard_metrics(
     return violations
 
 
+def find_bad_exemplars(
+    exposition: str,
+    where: str = "<exposition>",
+    cap: int = _MAX_EXEMPLARS_PER_FAMILY,
+) -> List[str]:
+    """Exemplar violations in a rendered /metrics exposition text.
+
+    Three rules: exemplar labels must be exactly ``trace_id`` (an
+    exemplar links a bucket to the flight recorder — anything else is a
+    cardinality side-channel around check 2), exemplars sit only on
+    ``_bucket`` samples (the OpenMetrics position for them; a _sum/_count
+    exemplar has no bucket to explain), and a family exposes at most
+    ``cap`` of them (the renderer's bound; more means the scrape payload
+    grows per-request)."""
+    violations = []
+    per_family: Dict[str, int] = {}
+    for lineno, line in enumerate(exposition.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue  # comment/HELP/TYPE lines, not samples
+        match = _EXEMPLAR_SUFFIX_RE.search(line)
+        if match is None:
+            continue  # plain sample, no exemplar
+        loc = f"{where}:{lineno}"
+        sample_name = line.split("{", 1)[0].split()[0]
+        if not sample_name.endswith("_bucket"):
+            violations.append(
+                f"{loc}: exemplar on non-bucket sample {sample_name!r} — "
+                f"exemplars belong on histogram _bucket lines only"
+            )
+            family = sample_name
+        else:
+            family = sample_name[: -len("_bucket")]
+        labels = _EXEMPLAR_LABEL_RE.findall(match.group("labels"))
+        if sorted(labels) != sorted(_EXEMPLAR_LABELS):
+            violations.append(
+                f"{loc}: exemplar labels {sorted(labels)!r} on "
+                f"{sample_name!r} — only {list(_EXEMPLAR_LABELS)!r} is "
+                f"allowed (an exemplar links a bucket to the flight "
+                f"recorder; extra labels are a cardinality side-channel)"
+            )
+        per_family[family] = per_family.get(family, 0) + 1
+    for family, count in sorted(per_family.items()):
+        if count > cap:
+            violations.append(
+                f"{where}: family {family!r} exposes {count} exemplars "
+                f"(cap {cap}) — the renderer's per-family bound regressed"
+            )
+    return violations
+
+
 def main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("roots", nargs="*", default=[])
@@ -288,6 +358,21 @@ def main(argv: List[str]) -> int:
         default=None,
         help="modules whose metric registrations ground the dashboard "
         "check",
+    )
+    parser.add_argument(
+        "--exposition",
+        nargs="*",
+        default=None,
+        help="rendered /metrics exposition files whose exemplars must "
+        "carry exactly the trace_id label and stay under the per-family "
+        "cap",
+    )
+    parser.add_argument(
+        "--max-exemplars-per-family",
+        type=int,
+        default=_MAX_EXEMPLARS_PER_FAMILY,
+        help="per-family exemplar cap for --exposition (default: the "
+        "renderer's bound)",
     )
     args = parser.parse_args(argv)
     roots = args.roots or ["gordo_tpu"]
@@ -316,6 +401,15 @@ def main(argv: List[str]) -> int:
         if dashboards is not None:
             violations.extend(
                 find_unknown_dashboard_metrics(dashboards, dashboard_catalogs)
+            )
+        for exposition in args.exposition or []:
+            path = pathlib.Path(exposition)
+            violations.extend(
+                find_bad_exemplars(
+                    path.read_text(errors="replace"),
+                    where=str(path),
+                    cap=args.max_exemplars_per_family,
+                )
             )
     except SyntaxError as exc:
         print(f"parse error: {exc}", file=sys.stderr)

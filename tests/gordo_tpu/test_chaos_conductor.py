@@ -187,6 +187,48 @@ def test_one_rebuild_per_machine_exactly_once():
     assert not _run("one_rebuild_per_machine", _ctx())["ok"]
 
 
+def _stitched_doc(victim="node-1", survivor="node-0", with_subtree=True):
+    def ev(name, span_id, parent, **attrs):
+        args = {"trace_id": "ab" * 16, "span_id": span_id,
+                "parent_span_id": parent}
+        args.update({k: str(v) for k, v in attrs.items()})
+        return {"name": name, "ph": "X", "args": args}
+
+    events = [
+        ev("gateway_request", "s-root", "", method="GET", status=200),
+        ev("gateway_route_resolve", "s-rr", "s-root", machine="m-000"),
+        ev("gateway_upstream_attempt", "s-a0", "s-root", node=victim,
+           attempt=0, error="ConnectionRefusedError(111)"),
+        ev("gateway_upstream_attempt", "s-a1", "s-root", node=survivor,
+           attempt=1, status=200),
+    ]
+    if with_subtree:
+        events += [
+            ev("serve_request", "s-n0", "s-a1", node=survivor, status=200),
+            ev("serve_batch_queue", "s-n1", "s-n0"),
+            ev("serve_device_call", "s-n2", "s-n1"),
+        ]
+    return {"traceEvents": events,
+            "gordoStitch": {"complete": with_subtree}}
+
+
+def test_stitched_trace_checker():
+    good = {"doc": _stitched_doc(), "victim": "node-1",
+            "trace_id": "ab" * 16}
+    result = _run("stitched_trace", _ctx(stitched=good))
+    assert result["ok"], result["detail"]
+    # no capture at all: fails with the conductor's reason
+    missing = _run("stitched_trace",
+                   _ctx(stitched={"reason": "probe never landed"}))
+    assert not missing["ok"] and "probe never landed" in missing["detail"]
+    # survivor subtree torn off (node died / gate off): partial is not ok
+    no_tree = {"doc": _stitched_doc(with_subtree=False), "victim": "node-1"}
+    assert not _run("stitched_trace", _ctx(stitched=no_tree))["ok"]
+    # the failed attempt must be on the declared victim
+    wrong_victim = {"doc": _stitched_doc(), "victim": "node-9"}
+    assert not _run("stitched_trace", _ctx(stitched=wrong_victim))["ok"]
+
+
 def test_unknown_invariant_fails_loudly():
     result = _run("definitely_not_a_check", _ctx())
     assert not result["ok"]
@@ -198,13 +240,17 @@ def test_conductor_tiny_drill_kill_one_node():
     """The smallest real drill: 2 subprocess nodes + in-process gateway,
     flat load, one node killed mid-window. Pins the whole conductor loop
     — stack boot, timeline firing, per-arrival accounting, invariant
-    evaluation — in a few seconds of tier-1 time."""
+    evaluation, and the stitched-trace failover capture — in a few
+    seconds of tier-1 time. This is the `make chaos-smoke` contract's
+    tier-1 twin (the committed scenario is the full-size drill)."""
     spec = scn.parse_scenario({
         "name": "tiny-drill",
         "seed": 1,
         "stack": {"nodes": 2, "lease_timeout_s": 1.5, "heartbeat_s": 0.15,
                   "gateway_env": {"health_s": "0.2",
                                   "connect_timeout_s": "0.5"}},
+        "env": {"GORDO_TPU_DEBUG_ENDPOINTS": "1",
+                "GORDO_TPU_FLIGHT_RECENT": "64"},
         "machines": 8,
         "load": {"phases": [{"shape": "flat", "qps": 25, "duration": 2.0,
                              "users": 4}]},
@@ -213,6 +259,7 @@ def test_conductor_tiny_drill_kill_one_node():
             {"check": "availability", "min": 0.9},
             {"check": "failover_under", "seconds": 2.0},
             {"check": "histogram_exact"},
+            {"check": "stitched_trace"},
         ],
     })
     directory = tempfile.mkdtemp(prefix="gordo-chaos-test-")
@@ -229,4 +276,26 @@ def test_conductor_tiny_drill_kill_one_node():
     assert report["failover_s"] is not None and report["failover_s"] <= 2.0
     checks = {r["check"]: r["ok"] for r in report["invariants"]}
     assert checks == {"availability": True, "failover_under": True,
-                      "histogram_exact": True}
+                      "histogram_exact": True, "stitched_trace": True}
+    # the captured trace is quotable: the report names the id an operator
+    # would pull from the gateway's /debug/flight?trace=
+    assert report["stitched_trace"]["trace_id"]
+    assert report["stitched_trace"]["victim"] == "node-1"
+
+
+def test_chaos_smoke_scenario_is_the_committed_one():
+    """`make chaos-smoke` and tier-1 must drill the same contract: the
+    committed kill_node_mid_ramp.yaml declares the stitched-trace
+    assertion (plus the debug/flight knobs it needs), so the Makefile
+    target and CI cannot drift apart on what failover evidence means."""
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    spec = scn.load_scenario(
+        os.path.join(repo, "resources", "chaos", "kill_node_mid_ramp.yaml")
+    )
+    assert "stitched_trace" in {i.check for i in spec.invariants}
+    assert spec.env.get("GORDO_TPU_DEBUG_ENDPOINTS") == "1"
+    assert int(spec.env.get("GORDO_TPU_FLIGHT_RECENT", "0")) > 0
+    makefile = open(os.path.join(repo, "Makefile")).read()
+    assert "kill_node_mid_ramp.yaml" in makefile

@@ -138,6 +138,29 @@ def test_handle_response_errors():
         _handle_response(FakeResp(500))
 
 
+def test_handle_response_quotes_trace_and_gateway_node():
+    """Errors that crossed the gateway name both the trace id and the
+    node the request landed on — together they point at the one machine
+    whose /debug/flight holds the node-side subtree."""
+    class RoutedResp:
+        status_code = 500
+        content = b"{}"
+        headers = {
+            "Content-Type": "application/json",
+            "X-Gordo-Trace": "deadbeef" * 4,
+            "X-Gordo-Gateway-Node": "node-2",
+        }
+
+        def json(self):
+            return {"error": "boom"}
+
+    with pytest.raises(IOError) as excinfo:
+        _handle_response(RoutedResp())
+    message = str(excinfo.value)
+    assert f"[trace {'deadbeef' * 4}]" in message
+    assert "[via node-2]" in message
+
+
 # ----------------------------------------------- Retry-After (ISSUE 12)
 class _BusyResp:
     """A 503 shaped like the server's shed gate / breaker / gateway

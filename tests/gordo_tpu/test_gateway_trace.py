@@ -1,0 +1,445 @@
+"""
+Gateway trace plane: traceparent propagation, cross-node stitching,
+exemplar-linked metrics (the fleet-trace ISSUE).
+
+- **Propagation**: a routed request's ``traceparent`` reaches the node
+  with the SAME trace id but a NEW parent span (the gateway's upstream
+  attempt span), over both lanes — pooled TCP keep-alive and the
+  Unix-domain fast lane — and every request on a reused (pipelined)
+  upstream connection carries its own, not a stale neighbour's.
+- **Stitching**: ``GET /debug/flight?trace=<id>`` on the gateway grafts
+  each upstream node's subtree into one Chrome-trace document; a node
+  dying mid-fetch (torn stitch) degrades to an explicit ``gordoStitch``
+  entry, never an error.
+- **Exemplars**: the gateway's /metrics carries OpenMetrics exemplars
+  whose trace ids resolve against the same /debug/flight surface.
+- **Hot path**: with tracing off (no inbound traceparent, knob unset)
+  the gateway allocates NOTHING in the tracing/flight modules —
+  tracemalloc-pinned, so the trace plane stays opt-in for free.
+"""
+
+import http.client
+import json
+import re
+import socket
+import threading
+import time
+import tracemalloc
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from gordo_tpu.observability import tracing
+from gordo_tpu.server import gateway, membership
+from gordo_tpu.util import faults
+
+
+def _make_gateway(tmp_path) -> gateway.GatewayServer:
+    return gateway.GatewayServer(str(tmp_path), host="127.0.0.1", port=0)
+
+
+def _gateway_request(server, method, path, headers=None, timeout=10):
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", server.server_port, timeout=timeout
+    )
+    try:
+        conn.request(method, path, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+    finally:
+        conn.close()
+
+
+class _TraceStubNode:
+    """A fake serving node that RECORDS every inbound ``traceparent`` and
+    answers ``/debug/flight?trace=<id>`` like a real node's debug surface:
+    a canned serve_request subtree for traces it saw, 404 for the rest.
+    ``tear_debug=True`` severs the connection on the debug route instead —
+    the node dying mid-fetch."""
+
+    def __init__(self, directory: str, node_id: str, tear_debug=False):
+        self.node_id = node_id
+        self.traceparents = []
+        self.tear_debug = tear_debug
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _answer(self):
+                path, _, query = self.path.partition("?")
+                if path == "/debug/flight":
+                    return self._flight(query)
+                node.traceparents.append(self.headers.get("traceparent"))
+                body = json.dumps(
+                    {"node": node.node_id, "path": self.path}
+                ).encode()
+                self._reply(200, body)
+
+            def _flight(self, query):
+                if node.tear_debug:
+                    # die mid-fetch: no status line, just a severed socket
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                    self.close_connection = True
+                    return
+                trace_id = None
+                for part in query.split("&"):
+                    name, _, value = part.partition("=")
+                    if name == "trace":
+                        trace_id = value
+                seen = [
+                    tracing.parse_traceparent(tp)
+                    for tp in node.traceparents if tp
+                ]
+                match = next(
+                    (pair for pair in seen if pair and pair[0] == trace_id),
+                    None,
+                )
+                if match is None:
+                    self._reply(404, json.dumps(
+                        {"error": "trace not kept"}
+                    ).encode())
+                    return
+                trace_id, parent_span = match
+                doc = {
+                    "traceEvents": [{
+                        "name": "serve_request", "ph": "X", "ts": 0,
+                        "dur": 1000, "pid": 1, "tid": 1,
+                        "args": {
+                            "trace_id": trace_id,
+                            "span_id": "feedface00000001",
+                            "parent_span_id": parent_span,
+                        },
+                    }],
+                    "gordoFlight": [{"trace_id": trace_id}],
+                }
+                self._reply(200, json.dumps(doc).encode())
+
+            def _reply(self, status, body):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _answer
+
+            def log_message(self, *args):  # silence
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.registration = membership.NodeRegistration(
+            directory,
+            address=f"127.0.0.1:{self.port}",
+            node_id=node_id,
+        )
+
+    def close(self):
+        self.registration.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.thread.join(timeout=2.0)
+
+
+@pytest.fixture
+def traced_fleet(tmp_path, monkeypatch):
+    """One stub node + gateway, lease/health knobs tightened for tests;
+    debug endpoints on so the stitching surface is reachable."""
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.5")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.2")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_HEALTH_S", "0.3")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    faults.reset_plan()
+    node = _TraceStubNode(str(tmp_path), "node-a")
+    server = _make_gateway(tmp_path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 5.0
+    while not server.ring.nodes and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert server.ring.nodes
+    yield SimpleNamespace(server=server, node=node)
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=5)
+    node.close()
+
+
+def _traced_headers():
+    trace_id = tracing.new_trace_id()
+    span_id = tracing.new_span_id()
+    return trace_id, span_id, {"traceparent": f"00-{trace_id}-{span_id}-01"}
+
+
+# ----------------------------------------------------------- propagation
+def test_traceparent_continues_with_new_parent_over_tcp(traced_fleet):
+    """The node receives the caller's trace id under a NEW parent span —
+    the gateway's attempt span — so node-side serve_request trees hang
+    under the hedge arm that actually carried them."""
+    trace_id, span_id, headers = _traced_headers()
+    status, out_headers, _ = _gateway_request(
+        traced_fleet.server, "GET", "/gordo/v0/proj/m-1/metadata",
+        headers=headers,
+    )
+    assert status == 200
+    assert out_headers["x-gordo-trace"] == trace_id
+    assert "gateway_s;dur=" in out_headers["server-timing"]
+    seen = [tp for tp in traced_fleet.node.traceparents if tp]
+    assert seen, "node never saw a traceparent"
+    got_trace, got_parent = tracing.parse_traceparent(seen[-1])
+    assert got_trace == trace_id
+    assert got_parent != span_id  # re-parented under the attempt span
+
+
+def test_pipelined_keepalive_requests_each_carry_own_traceparent(
+    traced_fleet,
+):
+    """Three traced requests for the same machine ride the same pooled
+    upstream keep-alive connection — each must carry ITS trace id, not a
+    stale neighbour's from the reused connection."""
+    server = traced_fleet.server
+    sent = []
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", server.server_port, timeout=10
+    )
+    try:
+        for _ in range(3):
+            trace_id, _, headers = _traced_headers()
+            sent.append(trace_id)
+            conn.request(
+                "GET", "/gordo/v0/proj/m-1/metadata", headers=headers
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            assert resp.headers["X-Gordo-Trace"] == trace_id
+    finally:
+        conn.close()
+    received = [
+        tracing.parse_traceparent(tp)[0]
+        for tp in traced_fleet.node.traceparents if tp
+    ]
+    assert received[-3:] == sent
+
+
+def _recording_wsgi_app(record):
+    def app(environ, start_response):
+        record.append(environ.get("HTTP_TRACEPARENT"))
+        body = json.dumps({"node": "uds-only"}).encode()
+        start_response(
+            "200 OK",
+            [("Content-Type", "application/json"),
+             ("Content-Length", str(len(body)))],
+        )
+        return [body]
+    return app
+
+
+def test_traceparent_propagates_over_uds_lane(tmp_path, monkeypatch):
+    """Same continuation contract on the Unix-domain lane: the lease's
+    TCP address is dead, so the traceparent can only have traveled UDS —
+    and keep-alive reuse of that lane keeps per-request ids distinct."""
+    from gordo_tpu.server import fastlane
+
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.5")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.2")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_HEALTH_S", "5.0")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S", "0.5")
+    received = []
+    sock_path = str(tmp_path / "node-uds.sock")
+    node = fastlane.EventLoopServer(
+        _recording_wsgi_app(received), host="127.0.0.1", port=0,
+        uds=sock_path,
+    )
+    node_thread = threading.Thread(target=node.serve_forever, daemon=True)
+    node_thread.start()
+    registration = membership.NodeRegistration(
+        str(tmp_path), address="127.0.0.1:1",  # dead TCP: UDS or bust
+        node_id="node-uds", uds=sock_path,
+    )
+    server = _make_gateway(tmp_path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not server.ring.nodes and time.monotonic() < deadline:
+            time.sleep(0.05)
+        sent = []
+        for _ in range(3):
+            trace_id, span_id, headers = _traced_headers()
+            sent.append((trace_id, span_id))
+            status, out_headers, _ = _gateway_request(
+                server, "GET", "/gordo/v0/proj/m-1/metadata",
+                headers=headers,
+            )
+            assert status == 200
+            assert out_headers["x-gordo-trace"] == trace_id
+        got = [tracing.parse_traceparent(tp) for tp in received if tp]
+        assert [pair[0] for pair in got] == [pair[0] for pair in sent]
+        for (_, client_span), (_, node_parent) in zip(sent, got):
+            assert node_parent != client_span  # re-parented at gateway
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        registration.close()
+        node.server_close()
+        node_thread.join(timeout=5)
+
+
+# ------------------------------------------------------------- stitching
+def test_stitched_flight_grafts_node_subtree(traced_fleet):
+    """/debug/flight?trace= returns ONE document: the gateway's own span
+    tree plus the node's serve_request subtree, tagged with the node id
+    and parented (by span ids) under the gateway's attempt span."""
+    server, node = traced_fleet.server, traced_fleet.node
+    trace_id, _, headers = _traced_headers()
+    status, _, _ = _gateway_request(
+        server, "GET", "/gordo/v0/proj/m-1/metadata", headers=headers
+    )
+    assert status == 200
+    status, _, body = _gateway_request(
+        server, "GET", f"/debug/flight?trace={trace_id}"
+    )
+    assert status == 200, body[:300]
+    doc = json.loads(body)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "gateway_request" in names
+    assert "gateway_upstream_attempt" in names
+    assert "serve_request" in names
+    stitch = doc["gordoStitch"]
+    assert stitch["trace_id"] == trace_id
+    assert stitch["complete"] is True
+    assert stitch["nodes"] == [
+        {"node": "node-a", "ok": True, "events": 1}
+    ]
+    grafted = next(
+        e for e in doc["traceEvents"] if e["name"] == "serve_request"
+    )
+    assert grafted["args"]["gordo_node"] == "node-a"
+    attempts = {
+        e["args"]["span_id"]
+        for e in doc["traceEvents"]
+        if e["name"] == "gateway_upstream_attempt"
+    }
+    assert grafted["args"]["parent_span_id"] in attempts
+
+
+def test_stitched_flight_unknown_trace_is_404(traced_fleet):
+    status, _, body = _gateway_request(
+        traced_fleet.server, "GET", f"/debug/flight?trace={'0' * 32}"
+    )
+    assert status == 404
+    assert b"not kept" in body
+
+
+def test_torn_stitch_node_dies_mid_fetch(tmp_path, monkeypatch):
+    """A node severing the connection during the subtree fetch (torn
+    stitch) degrades to an explicit partial: the gateway's own subtree
+    still returns 200, with the loss named in gordoStitch."""
+    monkeypatch.setenv(membership.LEASE_TIMEOUT_ENV, "2.5")
+    monkeypatch.setenv(membership.HEARTBEAT_ENV, "0.2")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_HEALTH_S", "0.3")
+    monkeypatch.setenv("GORDO_TPU_GATEWAY_CONNECT_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("GORDO_TPU_DEBUG_ENDPOINTS", "1")
+    node = _TraceStubNode(str(tmp_path), "node-torn", tear_debug=True)
+    server = _make_gateway(tmp_path)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not server.ring.nodes and time.monotonic() < deadline:
+            time.sleep(0.05)
+        trace_id, _, headers = _traced_headers()
+        status, _, _ = _gateway_request(
+            server, "GET", "/gordo/v0/proj/m-1/metadata", headers=headers
+        )
+        assert status == 200
+        status, _, body = _gateway_request(
+            server, "GET", f"/debug/flight?trace={trace_id}"
+        )
+        assert status == 200, body[:300]
+        doc = json.loads(body)
+        assert {e["name"] for e in doc["traceEvents"]} >= {
+            "gateway_request", "gateway_upstream_attempt"
+        }
+        stitch = doc["gordoStitch"]
+        assert stitch["complete"] is False
+        (entry,) = stitch["nodes"]
+        assert entry["node"] == "node-torn"
+        assert entry["ok"] is False
+        assert "unreachable" in entry["reason"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        node.close()
+
+
+# ------------------------------------------------------------- exemplars
+_EXEMPLAR_RE = re.compile(r'# \{trace_id="([0-9a-f]{32})"\}')
+
+
+def test_metrics_exemplar_trace_id_resolves_via_debug_flight(traced_fleet):
+    """The loop an operator actually walks: a bucket's exemplar on the
+    gateway's /metrics names a trace id, and that id resolves against the
+    SAME gateway's /debug/flight?trace= to the full routed tree."""
+    server = traced_fleet.server
+    trace_id, _, headers = _traced_headers()
+    status, _, _ = _gateway_request(
+        server, "GET", "/gordo/v0/proj/m-1/metadata", headers=headers
+    )
+    assert status == 200
+    status, _, exposition = _gateway_request(server, "GET", "/metrics")
+    assert status == 200
+    exemplar_ids = set(_EXEMPLAR_RE.findall(exposition.decode()))
+    assert trace_id in exemplar_ids
+    status, _, body = _gateway_request(
+        server, "GET", f"/debug/flight?trace={trace_id}"
+    )
+    assert status == 200
+    assert json.loads(body)["gordoStitch"]["trace_id"] == trace_id
+
+
+# --------------------------------------------------------------- hot path
+def test_untraced_path_allocates_nothing_in_trace_modules(traced_fleet):
+    """With no inbound traceparent and GORDO_TPU_GATEWAY_TRACE unset, the
+    routed path must make ZERO allocations in the tracing and flight
+    modules — the trace plane is opt-in, priced only when bought."""
+    server = traced_fleet.server
+    assert not server.trace_all
+    # warm the pooled upstream connection and any lazy codepaths first
+    for _ in range(3):
+        status, _, _ = _gateway_request(
+            server, "GET", "/gordo/v0/proj/m-1/metadata"
+        )
+        assert status == 200
+    trace_filters = [
+        tracemalloc.Filter(True, "*/observability/tracing.py"),
+        tracemalloc.Filter(True, "*/observability/flight.py"),
+    ]
+    tracemalloc.start(10)
+    try:
+        before = tracemalloc.take_snapshot().filter_traces(trace_filters)
+        for _ in range(5):
+            status, _, _ = _gateway_request(
+                server, "GET", "/gordo/v0/proj/m-1/metadata"
+            )
+            assert status == 200
+        after = tracemalloc.take_snapshot().filter_traces(trace_filters)
+    finally:
+        tracemalloc.stop()
+    grown = [
+        stat for stat in after.compare_to(before, "filename")
+        if stat.size_diff > 0 or stat.count_diff > 0
+    ]
+    assert not grown, f"untraced path touched trace modules: {grown}"

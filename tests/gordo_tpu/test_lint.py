@@ -410,6 +410,70 @@ def test_dashboard_lint_grounds_gateway_family(tmp_path):
     assert "gordo_gateway_proxy_seconds" in result.stdout
 
 
+# ------------------------------------------------ exemplar discipline
+def _run_exemplar_lint(tmp_path, exposition_text):
+    exposition = tmp_path / "metrics.txt"
+    exposition.write_text(exposition_text)
+    empty_root = tmp_path / "empty"
+    empty_root.mkdir(exist_ok=True)
+    # an explicit empty root keeps the default-tree checks out of the way;
+    # only the exemplar discipline is under test
+    return subprocess.run(
+        [
+            sys.executable, str(METRIC_LINT), str(empty_root),
+            "--exposition", str(exposition),
+        ],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_exemplar_lint_accepts_real_renderer_output(tmp_path):
+    """The telemetry renderer's own exemplar exposition is the reference:
+    trace_id-only labels, bucket lines only, under the per-family cap."""
+    from gordo_tpu.observability import telemetry, tracing
+
+    registry = telemetry.MetricsRegistry()
+    hist = registry.histogram(
+        "gordo_exemplar_demo_seconds", "demo", buckets=(0.01, 0.1, 1.0)
+    )
+    for value in (0.005, 0.05, 0.5):
+        with tracing.request_root():
+            hist.observe(value)
+    text = registry.render_text()
+    assert " # {" in text, "renderer stopped emitting exemplars"
+    result = _run_exemplar_lint(tmp_path, text)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_exemplar_lint_flags_foreign_labels(tmp_path):
+    result = _run_exemplar_lint(
+        tmp_path,
+        'gordo_x_seconds_bucket{le="1"} 3 # {trace_id="a",user="bob"} '
+        "0.5 1.0\n"
+        'gordo_x_seconds_bucket{le="2"} 3 # {span_id="a"} 0.5 1.0\n',
+    )
+    assert result.returncode == 1
+    assert "'user'" in result.stdout
+    assert "'span_id'" in result.stdout
+    assert "only ['trace_id']" in result.stdout
+
+
+def test_exemplar_lint_flags_non_bucket_and_cap(tmp_path):
+    over_cap = "\n".join(
+        f'gordo_x_seconds_bucket{{le="{i}"}} 1 # {{trace_id="t{i}"}} 0.5 1.0'
+        for i in range(17)
+    )
+    result = _run_exemplar_lint(
+        tmp_path,
+        'gordo_x_seconds_sum 1.2 # {trace_id="a"} 0.5 1.0\n' + over_cap,
+    )
+    assert result.returncode == 1
+    assert "non-bucket sample 'gordo_x_seconds_sum'" in result.stdout
+    assert "exposes 17 exemplars (cap 16)" in result.stdout
+
+
 # -------------------------------------------- artifact-manifest lint
 def _run_manifest_lint(*args):
     return subprocess.run(
